@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Throughput benchmark: full MAML++ meta-steps/sec on the flagship config.
+
+Config benched: the reference's default training recipe (``config.yaml``):
+Omniglot 20-way 5-shot, VGG Conv-4 backbone, meta-batch 8 tasks, 5 inner
+steps, second-order meta-gradients, MSL active, learnable per-tensor lrs —
+one full outer update per step (forward+inner rollout+second-order backward+
+outer Adam + projection).
+
+Baseline: the reference records no throughput numbers (SURVEY.md §6). Its
+published runs are 150 epochs x 500 iters = 75,000 meta-steps over ~8-40 h of
+single-GPU wall-clock (run-dir mtimes, BASELINE.md) => 0.5-2.6 steps/s. We take
+the *fastest* plausible reference throughput, 2.6 steps/s, as the conservative
+baseline; ``vs_baseline`` = ours / 2.6.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.config import Config
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+
+REFERENCE_STEPS_PER_SEC = 2.6  # fastest plausible single-GPU reference (see docstring)
+
+
+def main():
+    cfg = Config()  # reference defaults: omniglot 20-way 5-shot, vgg, B=8, 5 steps
+    system = MAMLSystem(cfg)
+    state = system.init_train_state()
+    batch = {
+        k: jnp.asarray(v)
+        for k, v in synthetic_batch(
+            cfg.batch_size,
+            cfg.num_classes_per_set,
+            cfg.num_samples_per_class,
+            cfg.num_target_samples,
+            cfg.image_shape,
+            seed=0,
+        ).items()
+    }
+
+    # warmup / compile
+    state, out = system.train_step(state, batch)
+    out.loss.block_until_ready()
+
+    n_iters = 30
+    start = time.perf_counter()
+    for _ in range(n_iters):
+        state, out = system.train_step(state, batch)
+    out.loss.block_until_ready()
+    elapsed = time.perf_counter() - start
+    steps_per_sec = n_iters / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "meta_steps_per_sec_omniglot20w5s_vgg_b8_5steps_2nd_order",
+                "value": round(steps_per_sec, 3),
+                "unit": "meta-steps/sec/chip",
+                "vs_baseline": round(steps_per_sec / REFERENCE_STEPS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""TPU-native MAML++ meta-learning framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``bamos/HowToTrainYourMAMLPytorch`` (mounted read-only at ``/root/reference``):
+episodic few-shot classification on Omniglot / Mini-ImageNet with second-order
+MAML/MAML++ meta-gradients, differentiable inner optimizers (SGD / Adam /
+Rprop) with outer-loop-learnable per-tensor hyperparameters (LSLR generalized),
+multi-step-loss (MSL) annealing, a deterministic seeded episode pipeline, an
+experiment runner with CSV/JSON artifacts, and full-train-state
+checkpoint/resume.
+
+Design stance (see SURVEY.md §7): everything numeric is a pure function over
+pytrees compiled by XLA. The reference's ``higher`` monkey-patching machinery
+(reference ``few_shot_learning_system.py:215-251``) disappears — "functional
+model + differentiable optimizer" is the native JAX idiom. The inner loop is a
+``lax.scan`` rollout with per-step rematerialization, tasks are ``vmap``-ped,
+meta-batches are sharded over the TPU ICI mesh, and second-order meta-gradients
+come from XLA autodiff.
+"""
+
+__version__ = "0.1.0"
+
+from . import config, core, data, experiment, models, ops, parallel, utils  # noqa: F401

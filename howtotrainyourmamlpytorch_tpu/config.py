@@ -1,0 +1,253 @@
+"""Typed experiment configuration.
+
+Replaces the reference's hydra-0.x single-file YAML (``config.yaml``) with a
+typed dataclass schema + YAML file + ``key=value`` dotlist overrides, keeping
+every key from the reference schema (SURVEY.md §2.8) plus the TPU-specific
+additions (mesh shape, precision, remat policy). Named dataset and
+inner-optimizer presets replace hydra's ``${omniglot}`` / ``${gd}`` node
+interpolation (reference ``config.yaml:14,68``) and class-path instantiation
+(reference ``few_shot_learning_system.py:87-88``).
+"""
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DatasetConfig:
+    # reference config.yaml:28-34
+    name: str = "omniglot_dataset"
+    path: str = "datasets/omniglot_dataset"
+
+
+@dataclass
+class InnerOptimConfig:
+    # reference config.yaml:68-85 (`gd`/`rprop`/`adam` presets)
+    kind: str = "sgd"
+    lr: float = 0.1
+    beta1: float = 0.5
+    beta2: float = 0.5
+
+
+INNER_OPTIM_PRESETS: Dict[str, InnerOptimConfig] = {
+    "gd": InnerOptimConfig(kind="sgd", lr=0.1),
+    "sgd": InnerOptimConfig(kind="sgd", lr=0.1),
+    "rprop": InnerOptimConfig(kind="rprop", lr=0.1),
+    "adam": InnerOptimConfig(kind="adam", lr=0.1, beta1=0.5, beta2=0.5),
+}
+
+DATASET_PRESETS: Dict[str, DatasetConfig] = {
+    "omniglot": DatasetConfig(name="omniglot_dataset", path="datasets/omniglot_dataset"),
+    "imagenet": DatasetConfig(
+        name="mini_imagenet_full_size", path="datasets/mini_imagenet_full_size"
+    ),
+}
+
+
+@dataclass
+class ParallelConfig:
+    """TPU mesh layout — no reference equivalent (single GPU hard-coded at
+    ``train_maml_system.py:23``); SURVEY.md §2.11 requires a 2D (data x model)
+    mesh API. The meta-batch shards over ``dp``; ``mp`` is exposed for
+    parameter sharding of larger backbones."""
+
+    dp: int = -1  # -1: use all visible devices
+    mp: int = 1
+    # shard tasks of one meta-batch across dp; meta-grads psum over the mesh.
+    shard_meta_batch: bool = True
+
+
+@dataclass
+class Config:
+    # --- data provider (reference config.yaml:11-20,63-65) ---
+    num_dataprovider_workers: int = 4
+    max_models_to_save: int = 5
+    dataset: DatasetConfig = field(default_factory=DatasetConfig)
+    sets_are_pre_split: bool = False
+    load_from_npz_files: bool = False  # unused in reference code; kept for schema parity
+    load_into_memory: bool = True
+    samples_per_iter: int = 1
+    num_target_samples: int = 1
+    reverse_channels: bool = False
+    labels_as_int: bool = False
+    reset_stored_filepaths: bool = False
+    # where the dataset index JSONs are cached; empty = next to the dataset dir
+    # (the reference location, data.py:252) — set this when the dataset lives
+    # on a read-only mount.
+    index_cache_dir: str = ""
+    # optional override of the per-dataset class-split ratios (reference
+    # hard-codes them per dataset, data.py:125,129); empty = dataset default.
+    train_val_test_split: List[float] = field(default_factory=list)
+
+    def __post_init__(self):
+        # normalize so YAML round-trips compare equal
+        self.train_val_test_split = list(self.train_val_test_split)
+
+    # --- episode shape (reference config.yaml:22-26) ---
+    num_classes_per_set: int = 20
+    num_samples_per_class: int = 5
+    batch_size: int = 8
+    num_of_gpus: int = 1  # kept for schema parity; superseded by parallel.dp
+
+    # --- seeds (reference config.yaml:36-39) ---
+    seed: int = 0
+    train_seed: int = 0
+    val_seed: int = 0
+    test_seed: int = 0
+    # reference quirk (data.py:143-148): the test episode stream is seeded from
+    # val_seed, ignoring test_seed. True reproduces the reference.
+    test_stream_uses_val_seed: bool = True
+
+    # --- MAML++ core (reference config.yaml:41-56) ---
+    learnable_inner_opt_params: bool = True
+    use_multi_step_loss_optimization: bool = True
+    multi_step_loss_num_epochs: int = 10
+    minimum_per_task_contribution: float = 0.01  # unused in reference; schema parity
+    second_order: bool = True
+    first_order_to_second_order_epoch: int = -1
+    number_of_training_steps_per_iter: int = 5
+    number_of_evaluation_steps_per_iter: int = 5
+
+    # --- schedule (reference config.yaml:46-61) ---
+    num_evaluation_tasks: int = 600
+    total_epochs: int = 150
+    total_epochs_before_pause: int = 150
+    total_iter_per_epoch: int = 500
+    continue_from_epoch: str = "latest"
+    evaluate_on_test_set_only: bool = False
+    meta_learning_rate: float = 0.001
+    min_learning_rate: float = 1.0e-05
+
+    # --- model / inner optim (reference config.yaml:67-85) ---
+    net: str = "vgg"
+    inner_optim: InnerOptimConfig = field(default_factory=InnerOptimConfig)
+    # Reference deep-copies the outer Adam's per-param state into the inner
+    # optimizer before each task's rollout (few_shot_learning_system.py:219-220,
+    # with a one-task lag). We implement the *intent* — inner Adam moments seeded
+    # from the outer optimizer's current state, no lag — and only for inner Adam
+    # (the deepcopy would poison SGD/Rprop state dicts). SURVEY.md §2.2.
+    warm_start_inner_opt_from_outer: bool = True
+
+    # --- experiment dirs ---
+    experiment_name: str = ""  # default: {dataset}.{n_way}.{k_shot}
+    experiment_root: str = "exps"
+
+    # --- TPU-native knobs (no reference equivalent) ---
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    compute_dtype: str = "float32"  # or "bfloat16" for MXU-friendly compute
+    remat_inner_steps: bool = True  # jax.checkpoint per inner step (SURVEY §5.7)
+    profile_dir: str = ""  # non-empty: write jax.profiler traces here
+
+    # ------------------------------------------------------------------
+    @property
+    def image_shape(self):
+        """(H, W, C) from the dataset registry (reference
+        few_shot_learning_system.py:41-46 hard-codes the same table)."""
+        from .data.registry import get_dataset_spec  # local: avoid import cycle
+
+        return get_dataset_spec(self.dataset.name).image_shape
+
+    @property
+    def is_imagenet(self) -> bool:
+        return "imagenet" in self.dataset.name
+
+    def run_name(self) -> str:
+        # reference hydra run-dir naming: {dataset}.{n_way}.{k_shot}.local
+        # (config.yaml:2-4)
+        if self.experiment_name:
+            return self.experiment_name
+        return f"{self.dataset.name}.{self.num_classes_per_set}.{self.num_samples_per_class}"
+
+    def run_dir(self) -> str:
+        return os.path.join(self.experiment_root, self.run_name())
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# Loading: YAML + dotlist overrides
+# ---------------------------------------------------------------------------
+
+
+def _coerce(value: str) -> Any:
+    try:
+        return json.loads(value)
+    except (json.JSONDecodeError, ValueError):
+        return value
+
+
+def _set_dotted(data: Dict[str, Any], dotted: str, value: Any) -> None:
+    keys = dotted.split(".")
+    node = data
+    for key in keys[:-1]:
+        node = node.setdefault(key, {})
+    node[keys[-1]] = value
+
+
+def _merge(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _dataclass_from_dict(cls, data: Dict[str, Any]):
+    kwargs = {}
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise KeyError(f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    for name, f in fields.items():
+        if name not in data:
+            continue
+        value = data[name]
+        if name in ("dataset", "inner_optim", "parallel"):
+            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig}[name]
+            presets = {"dataset": DATASET_PRESETS, "inner_optim": INNER_OPTIM_PRESETS}.get(name, {})
+            if isinstance(value, str):
+                if value not in presets:
+                    raise KeyError(f"unknown {name} preset {value!r}; have {sorted(presets)}")
+                value = dataclasses.replace(presets[value])
+            elif isinstance(value, dict):
+                value = _dataclass_from_dict(sub_cls, value)
+        kwargs[name] = value
+    return cls(**kwargs)
+
+
+def load_config(
+    yaml_path: Optional[str] = None,
+    overrides: Optional[List[str]] = None,
+) -> Config:
+    """Build a Config from an optional YAML file and ``key=value`` overrides.
+
+    Overrides use dotted paths (``inner_optim.lr=0.05``); preset names can be
+    given for ``dataset=`` / ``inner_optim=`` (e.g. ``inner_optim=adam``),
+    mirroring the reference's ``inner_optim: ${gd}`` node interpolation.
+    """
+    data: Dict[str, Any] = {}
+    if yaml_path:
+        with open(yaml_path) as f:
+            loaded = yaml.safe_load(f) or {}
+        data = _merge(data, loaded)
+    for item in overrides or []:
+        if "=" not in item:
+            raise ValueError(f"override {item!r} is not key=value")
+        key, _, raw = item.partition("=")
+        _set_dotted(data, key.strip(), _coerce(raw.strip()))
+    return _dataclass_from_dict(Config, data)
+
+
+def save_config(cfg: Config, path: str) -> None:
+    with open(path, "w") as f:
+        yaml.safe_dump(cfg.to_dict(), f, sort_keys=False)

@@ -1,0 +1,2 @@
+from .maml import MAMLSystem, StepOutput, cosine_epoch_schedule  # noqa: F401
+from .train_state import TrainState  # noqa: F401

@@ -1,0 +1,400 @@
+"""The MAML++ meta-step as a single jit-compiled XLA program.
+
+This replaces the reference's entire hot path (reference
+``few_shot_learning_system.py:178-269,310-364``): the Python loop over tasks
+becomes ``jax.vmap``; the inner adaptation loop becomes ``lax.scan`` with
+per-step rematerialization (``jax.checkpoint``) so memory is O(1) in inner
+steps; the ``higher`` second-order backprop becomes ``jax.grad`` of the scanned
+rollout; and the outer Adam + cosine schedule + hyperparameter projection run
+in the same compiled program. One program per (n_way, k_shot, steps) shape —
+the epoch index is a traced scalar so MSL annealing never recompiles.
+
+Restored knob: the reference accepts ``use_second_order`` but ignores it
+(training is always second-order because ``track_higher_grads=True`` —
+reference ``few_shot_learning_system.py:178,215-218``; SURVEY.md §2.2). Here
+first-order MAML is a real option: ``stop_gradient`` on the inner grads.
+"""
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from ..config import Config
+from ..models import Model, build_model
+from ..ops import build_inner_optimizer
+from ..ops.losses import accuracy, cross_entropy
+from ..ops.msl import final_step_only, per_step_loss_importance
+from ..utils import seeding
+from ..utils.trees import tree_count_params
+from .train_state import TrainState
+
+
+class StepOutput(NamedTuple):
+    loss: jnp.ndarray
+    accuracy: jnp.ndarray
+    per_task_losses: jnp.ndarray  # [B]
+    per_task_target_logits: jnp.ndarray  # [B, n_target, n_way]
+    loss_importance_vector: jnp.ndarray  # [num_steps]
+    learning_rate: jnp.ndarray
+
+
+def cosine_epoch_schedule(meta_lr: float, min_lr: float, total_epochs: int, iters_per_epoch: int):
+    """CosineAnnealingLR stepped once per *epoch* with the integer epoch index —
+    the reference calls ``scheduler.step(epoch=int(epoch))`` every iteration
+    (``few_shot_learning_system.py:339-340``), which is the closed form below."""
+
+    def schedule(count):
+        epoch = jnp.asarray(count // iters_per_epoch, jnp.float32)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * epoch / total_epochs))
+        return min_lr + (meta_lr - min_lr) * cos
+
+    return schedule
+
+
+def _flatten_task(x):
+    """[n_way, k, ...] -> [n_way*k, ...] (reference view(-1, c, h, w))."""
+    return x.reshape((-1,) + x.shape[2:])
+
+
+class MAMLSystem:
+    """Builds and owns the compiled meta-train / meta-eval programs.
+
+    Functional analogue of the reference's ``MAMLFewShotClassifier``; all
+    mutable state lives in the ``TrainState`` pytree the caller threads
+    through ``train_step`` / ``eval_step``.
+    """
+
+    def __init__(self, cfg: Config, model: Optional[Model] = None):
+        self.cfg = cfg
+        self.model = model or build_model(
+            cfg.net, cfg.image_shape, cfg.num_classes_per_set
+        )
+        io = cfg.inner_optim
+        kwargs = {"lr": io.lr}
+        if io.kind == "adam":
+            kwargs.update(beta1=io.beta1, beta2=io.beta2)
+        self.inner_opt = build_inner_optimizer(io.kind, **kwargs)
+        self.schedule = cosine_epoch_schedule(
+            cfg.meta_learning_rate,
+            cfg.min_learning_rate,
+            cfg.total_epochs,
+            cfg.total_iter_per_epoch,
+        )
+        self.outer_opt = optax.adam(learning_rate=self.schedule)
+        self.compute_dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+
+        # Compiled program cache keyed by the static switches: (second_order,
+        # msl_active). msl_active selects the rollout shape — per-step target
+        # forwards during the MSL annealing window, a single final-step target
+        # forward afterwards (and always for eval), matching the reference's
+        # two code paths (few_shot_learning_system.py:239-251) without paying
+        # num_steps target forwards when only the last one counts.
+        self._train_step_cache = {}
+        self._eval_step = jax.jit(self._eval_step_impl)
+
+    # ------------------------------------------------------------------
+    # state
+    # ------------------------------------------------------------------
+
+    def init_train_state(self, seed: Optional[int] = None) -> TrainState:
+        key = seeding.model_init_key(self.cfg.seed if seed is None else seed)
+        params, bn_state = self.model.init(key)
+        if self.cfg.learnable_inner_opt_params:
+            inner_hparams = self.inner_opt.init_hparams(params)
+        else:
+            inner_hparams = {}
+        trainables = {"params": params, "hparams": inner_hparams}
+        opt_state = self.outer_opt.init(trainables)
+        return TrainState(
+            params=params,
+            bn_state=bn_state,
+            inner_hparams=inner_hparams,
+            opt_state=opt_state,
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def num_params(self, state: TrainState) -> int:
+        return tree_count_params({"params": state.params, "hparams": state.inner_hparams})
+
+    # ------------------------------------------------------------------
+    # inner rollout (per task)
+    # ------------------------------------------------------------------
+
+    def _inner_hparams_for_rollout(self, inner_hparams, params):
+        if self.cfg.learnable_inner_opt_params:
+            return inner_hparams
+        # Non-learnable: constant per-tensor scalars from config.
+        return self.inner_opt.init_hparams(params)
+
+    def _initial_inner_state(self, params, hparams, opt_state):
+        """Seed the inner optimizer state; for inner Adam, warm-start the
+        moments from the outer Adam's state (the *intent* of the reference's
+        deepcopy at ``few_shot_learning_system.py:219-220``, without the
+        one-task lag — decision documented in SURVEY.md §2.2 / config)."""
+        inner_state = self.inner_opt.init_state(params, hparams)
+        if not (
+            self.cfg.warm_start_inner_opt_from_outer
+            and self.inner_opt.name == "adam"
+            and opt_state is not None
+        ):
+            return inner_state
+        adam_state = None
+        for part in jax.tree.leaves(opt_state, is_leaf=lambda x: hasattr(x, "mu")):
+            if hasattr(part, "mu"):
+                adam_state = part
+                break
+        if adam_state is None:
+            return inner_state
+        count = jnp.asarray(adam_state.count, jnp.float32)
+        return {
+            "step": jax.tree.map(lambda p: count, params),
+            "exp_avg": adam_state.mu["params"],
+            "exp_avg_sq": adam_state.nu["params"],
+        }
+
+    def _rollout(
+        self,
+        params,
+        bn_state,
+        hparams,
+        inner_state,
+        x_support,
+        y_support,
+        x_target,
+        y_target,
+        loss_weights,
+        second_order: bool,
+        num_steps: int,
+        per_step_target: bool,
+    ):
+        """Adapt on the support set for ``num_steps``. With ``per_step_target``
+        (the MSL annealing window) the target loss is computed after *every*
+        inner step and accumulated with ``loss_weights``; otherwise only the
+        final adapted parameters see the target set — one target forward total,
+        the reference's post-annealing/eval path
+        (few_shot_learning_system.py:246-251). Returns
+        (task_loss, final_target_logits)."""
+        cdt = self.compute_dtype
+        model = self.model
+
+        def forward(p, x):
+            if cdt != jnp.float32:
+                p = jax.tree.map(lambda a: a.astype(cdt), p)
+                x = x.astype(cdt)
+            logits, _ = model.apply(p, bn_state, x, use_batch_stats=True)
+            return logits.astype(jnp.float32)
+
+        def inner_update(p, opt_s):
+            def support_loss_fn(q):
+                return cross_entropy(forward(q, x_support), y_support)
+
+            grads = jax.grad(support_loss_fn)(p)
+            if not second_order:
+                grads = jax.tree.map(lax.stop_gradient, grads)
+            return self.inner_opt.update(grads, opt_s, p, hparams)
+
+        if per_step_target:
+
+            def step(carry, weight):
+                p, opt_s, _ = carry
+                p_new, opt_s_new = inner_update(p, opt_s)
+                target_logits = forward(p_new, x_target)
+                target_loss = cross_entropy(target_logits, y_target)
+                return (p_new, opt_s_new, target_logits), weight * target_loss
+
+            if self.cfg.remat_inner_steps:
+                step = jax.checkpoint(step, prevent_cse=False)
+            logits0 = jnp.zeros((x_target.shape[0], self.cfg.num_classes_per_set))
+            (_, _, final_logits), weighted_losses = lax.scan(
+                step, (params, inner_state, logits0), loss_weights
+            )
+            return jnp.sum(weighted_losses), final_logits
+
+        def step(carry, _):
+            p, opt_s = carry
+            return inner_update(p, opt_s), None
+
+        if self.cfg.remat_inner_steps:
+            step = jax.checkpoint(step, prevent_cse=False)
+        (p_final, _), _ = lax.scan(step, (params, inner_state), None, length=num_steps)
+        final_logits = forward(p_final, x_target)
+        return cross_entropy(final_logits, y_target), final_logits
+
+    # ------------------------------------------------------------------
+    # meta objective over a task batch
+    # ------------------------------------------------------------------
+
+    def msl_active(self, epoch: int, training: bool = True) -> bool:
+        """Host-side static switch: per-step MSL weighting applies during
+        training in the annealing window (reference
+        few_shot_learning_system.py:239-240)."""
+        return bool(
+            training
+            and self.cfg.use_multi_step_loss_optimization
+            and epoch < self.cfg.multi_step_loss_num_epochs
+        )
+
+    def _loss_weights(self, epoch, num_steps, msl_active: bool):
+        if msl_active:
+            # traced epoch: annealing never recompiles within the window
+            return per_step_loss_importance(
+                epoch, num_steps, self.cfg.multi_step_loss_num_epochs
+            )
+        return final_step_only(num_steps)
+
+    def _meta_objective(
+        self, trainables, bn_state, opt_state, batch, epoch, second_order, num_steps,
+        msl_active
+    ):
+        params = trainables["params"]
+        hparams = self._inner_hparams_for_rollout(trainables["hparams"], params)
+        inner_state0 = self._initial_inner_state(params, hparams, opt_state)
+        loss_weights = self._loss_weights(epoch, num_steps, msl_active)
+
+        def per_task(x_s, y_s, x_t, y_t):
+            return self._rollout(
+                params,
+                bn_state,
+                hparams,
+                inner_state0,
+                _flatten_task(x_s),
+                _flatten_task(y_s),
+                _flatten_task(x_t),
+                _flatten_task(y_t),
+                loss_weights,
+                second_order,
+                num_steps,
+                per_step_target=msl_active,
+            )
+
+        task_losses, target_logits = jax.vmap(per_task)(
+            batch["x_support"], batch["y_support"], batch["x_target"], batch["y_target"]
+        )
+        # mean over tasks (reference get_across_task_loss_metrics,
+        # few_shot_learning_system.py:170-176)
+        loss = jnp.mean(task_losses)
+        y_t_flat = batch["y_target"].reshape(batch["y_target"].shape[0], -1)
+        acc = accuracy(
+            target_logits.reshape((-1,) + target_logits.shape[2:]),
+            y_t_flat.reshape(-1),
+        )
+        aux = {
+            "accuracy": acc,
+            "per_task_losses": task_losses,
+            "target_logits": target_logits,
+            "loss_weights": loss_weights,
+        }
+        return loss, aux
+
+    # ------------------------------------------------------------------
+    # compiled steps
+    # ------------------------------------------------------------------
+
+    def _train_step_impl(self, state: TrainState, batch, *, second_order: bool, msl_active: bool):
+        cfg = self.cfg
+        epoch = state.step // cfg.total_iter_per_epoch
+        trainables = {"params": state.params, "hparams": state.inner_hparams}
+        grad_fn = jax.value_and_grad(self._meta_objective, has_aux=True)
+        (loss, aux), grads = grad_fn(
+            trainables,
+            state.bn_state,
+            state.opt_state,
+            batch,
+            epoch,
+            second_order,
+            cfg.number_of_training_steps_per_iter,
+            msl_active,
+        )
+        if cfg.is_imagenet:
+            # element-wise clamp of classifier grads only (reference
+            # few_shot_learning_system.py:317-320)
+            grads = {
+                "params": jax.tree.map(lambda g: jnp.clip(g, -10.0, 10.0), grads["params"]),
+                "hparams": grads["hparams"],
+            }
+        updates, new_opt_state = self.outer_opt.update(grads, state.opt_state, trainables)
+        new_trainables = optax.apply_updates(trainables, updates)
+        new_hparams = new_trainables["hparams"]
+        if cfg.learnable_inner_opt_params:
+            new_hparams = self.inner_opt.project_hparams(new_hparams)
+        new_state = TrainState(
+            params=new_trainables["params"],
+            bn_state=state.bn_state,
+            inner_hparams=new_hparams,
+            opt_state=new_opt_state,
+            step=state.step + 1,
+        )
+        out = StepOutput(
+            loss=loss,
+            accuracy=aux["accuracy"],
+            per_task_losses=aux["per_task_losses"],
+            per_task_target_logits=aux["target_logits"],
+            loss_importance_vector=aux["loss_weights"],
+            learning_rate=self.schedule(state.step),
+        )
+        return new_state, out
+
+    def _eval_step_impl(self, state: TrainState, batch):
+        cfg = self.cfg
+        epoch = state.step // cfg.total_iter_per_epoch
+        trainables = {"params": state.params, "hparams": state.inner_hparams}
+        loss, aux = self._meta_objective(
+            trainables,
+            state.bn_state,
+            state.opt_state,
+            batch,
+            epoch,
+            False,
+            cfg.number_of_evaluation_steps_per_iter,
+            False,  # eval is always final-step-only (reference :239-251)
+        )
+        return StepOutput(
+            loss=loss,
+            accuracy=aux["accuracy"],
+            per_task_losses=aux["per_task_losses"],
+            per_task_target_logits=aux["target_logits"],
+            loss_importance_vector=aux["loss_weights"],
+            learning_rate=self.schedule(state.step),
+        )
+
+    # ------------------------------------------------------------------
+    # public API (mirrors reference run_train_iter / run_validation_iter)
+    # ------------------------------------------------------------------
+
+    def use_second_order(self, epoch: int) -> bool:
+        """Reference intent (few_shot_learning_system.py:288-289): second order
+        iff ``second_order`` and ``epoch > first_order_to_second_order_epoch``."""
+        return bool(
+            self.cfg.second_order and epoch > self.cfg.first_order_to_second_order_epoch
+        )
+
+    def _compiled_train_step(self, second_order: bool, msl_active: bool):
+        key = (second_order, msl_active)
+        if key not in self._train_step_cache:
+            self._train_step_cache[key] = jax.jit(
+                functools.partial(
+                    self._train_step_impl, second_order=second_order, msl_active=msl_active
+                ),
+                donate_argnums=(0,),
+            )
+        return self._train_step_cache[key]
+
+    def train_step(
+        self, state: TrainState, batch, epoch: Optional[int] = None
+    ) -> Tuple[TrainState, StepOutput]:
+        """One outer update. ``epoch`` (host int) selects the compiled program
+        variant; pass it in the training loop to avoid a host-device sync —
+        when omitted it is read from ``state.step`` (blocking)."""
+        if epoch is None:
+            epoch = int(state.step) // self.cfg.total_iter_per_epoch
+        step_fn = self._compiled_train_step(
+            self.use_second_order(epoch), self.msl_active(epoch)
+        )
+        return step_fn(state, batch)
+
+    def eval_step(self, state: TrainState, batch) -> StepOutput:
+        return self._eval_step(state, batch)

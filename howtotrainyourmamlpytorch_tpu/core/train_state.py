@@ -1,0 +1,21 @@
+"""The full training-state pytree.
+
+The reference checkpoints only ``state_dict()`` (meta-params + learned
+lrs/betas) and silently drops the outer Adam moments and scheduler position
+(reference ``few_shot_learning_system.py:409-417``; gap noted in SURVEY.md
+§5.4). Here the entire state of training is one pytree — params, BN state,
+learnable inner-opt hyperparams, outer optimizer state, and the step counter —
+so checkpoint/resume is exact.
+"""
+
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+
+
+class TrainState(NamedTuple):
+    params: Any  # classifier meta-parameters
+    bn_state: Any  # batch-norm running stats (inert under transductive BN)
+    inner_hparams: Any  # learnable per-tensor inner-opt hyperparams ({} if not learnable)
+    opt_state: Any  # outer optax state
+    step: jnp.ndarray  # global meta-step counter (int32 scalar)

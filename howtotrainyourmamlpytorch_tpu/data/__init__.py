@@ -1,0 +1,4 @@
+from . import synthetic  # noqa: F401
+from .dataset import FewShotDataset  # noqa: F401
+from .loader import MetaLearningDataLoader  # noqa: F401
+from .registry import DatasetSpec, get_dataset_spec  # noqa: F401

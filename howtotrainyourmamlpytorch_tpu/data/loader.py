@@ -1,0 +1,102 @@
+"""Batched episode streams with background prefetch.
+
+Replaces the reference's fork-based ``torch.utils.data.DataLoader`` wrapper
+(``MetaLearningSystemDataLoader``, reference ``data.py:564-646``) with a
+thread-pool episode assembler: with the RAM cache on, episode construction is
+numpy gather + rot90 (GIL-friendly), and batches are assembled ahead of the
+consumer through a bounded in-flight window, then handed to the device
+asynchronously by the runner.
+
+Resume: the train stream position is a single integer (episodes produced);
+``continue_from_iter`` restores it exactly (reference ``data.py:592-597``).
+Batch ``b`` draws episode seeds ``init_train_seed + produced + j``. Val/test
+streams are fixed-seed, so evaluation episodes are identical every epoch
+(reference ``data.py:148-149``).
+
+Deviation (documented): the reference advances its train cursor by one
+batch-worth per *epoch* because the missing ExperimentBuilder drives a
+DataLoader over a length-capped dataset (SURVEY.md §2.4), which would replay
+nearly-identical episode streams across epochs. We advance the cursor per
+*batch*, giving a non-repeating deterministic stream and exact resume.
+"""
+
+import concurrent.futures
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from ..config import Config
+from .dataset import FewShotDataset
+
+
+def _stack(episodes) -> Dict[str, np.ndarray]:
+    return {k: np.stack([e[k] for e in episodes]) for k in episodes[0]}
+
+
+class MetaLearningDataLoader:
+    def __init__(
+        self,
+        cfg: Config,
+        dataset: Optional[FewShotDataset] = None,
+        current_iter: int = 0,
+        data_root: Optional[str] = None,
+    ):
+        self.cfg = cfg
+        self.dataset = dataset or FewShotDataset(cfg, data_root=data_root)
+        self.batch_size = cfg.batch_size * cfg.samples_per_iter
+        self.num_workers = max(cfg.num_dataprovider_workers, 1)
+        self.train_episodes_produced = 0
+        self.continue_from_iter(current_iter)
+
+    def continue_from_iter(self, current_iter: int) -> None:
+        self.train_episodes_produced = current_iter * self.batch_size
+
+    # ------------------------------------------------------------------
+
+    def _batches(
+        self,
+        split: str,
+        start_index: int,
+        total_batches: int,
+        augment: bool,
+        advance_train_cursor: bool,
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        ds = self.dataset
+        bs = self.batch_size
+
+        def build(batch_idx: int) -> Dict[str, np.ndarray]:
+            base = start_index + batch_idx * bs
+            with concurrent.futures.ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+                episodes = list(
+                    pool.map(
+                        lambda j: ds.sample_episode(split, ds.episode_seed(split, base + j), augment),
+                        range(bs),
+                    )
+                )
+            return _stack(episodes)
+
+        window = 2  # batches in flight ahead of the consumer
+        with concurrent.futures.ThreadPoolExecutor(max_workers=window) as ahead:
+            futures = {
+                i: ahead.submit(build, i) for i in range(min(window, total_batches))
+            }
+            for i in range(total_batches):
+                batch = futures.pop(i).result()
+                nxt = i + window
+                if nxt < total_batches:
+                    futures[nxt] = ahead.submit(build, nxt)
+                if advance_train_cursor:
+                    self.train_episodes_produced += bs
+                yield batch
+
+    def train_batches(self, total_batches: int, augment_images: bool = True):
+        """Deterministic resumable train stream (cursor advances per batch)."""
+        return self._batches(
+            "train", self.train_episodes_produced, total_batches, augment_images, True
+        )
+
+    def val_batches(self, total_batches: int, augment_images: bool = False):
+        return self._batches("val", 0, total_batches, augment_images, False)
+
+    def test_batches(self, total_batches: int, augment_images: bool = False):
+        return self._batches("test", 0, total_batches, augment_images, False)

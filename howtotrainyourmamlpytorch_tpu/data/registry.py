@@ -1,0 +1,50 @@
+"""Dataset registry constants (reference ``data.py:123-132``)."""
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class DatasetSpec:
+    # which path components name the class: "<grandparent>/<parent>" of each
+    # image file (reference data.py:124,128,370-380)
+    indexes_of_folders_indicating_class: Tuple[int, int]
+    train_val_test_split: Tuple[float, float, float]
+    image_height: int
+    image_width: int
+    image_channels: int
+    # per-episode per-class rotation augmentation (omniglot-only in reference:
+    # data.py:90-93 vs 96-104)
+    rotation_augmentation: bool
+    # normalization applied after load (imagenet: /255 at load + ImageNet
+    # mean/std in the transform, data.py:396-399,96-104)
+    normalize_mean: Tuple[float, ...] = ()
+    normalize_std: Tuple[float, ...] = ()
+
+    @property
+    def image_shape(self):
+        return (self.image_height, self.image_width, self.image_channels)
+
+
+def get_dataset_spec(dataset_name: str) -> DatasetSpec:
+    if "omniglot" in dataset_name:
+        return DatasetSpec(
+            indexes_of_folders_indicating_class=(-3, -2),
+            train_val_test_split=(0.70918052988, 0.03080714725, 0.2606284658),
+            image_height=28,
+            image_width=28,
+            image_channels=1,
+            rotation_augmentation=True,
+        )
+    if "imagenet" in dataset_name:
+        return DatasetSpec(
+            indexes_of_folders_indicating_class=(-3, -2),
+            train_val_test_split=(0.64, 0.16, 0.20),
+            image_height=84,
+            image_width=84,
+            image_channels=3,
+            rotation_augmentation=False,
+            normalize_mean=(0.485, 0.456, 0.406),
+            normalize_std=(0.229, 0.224, 0.225),
+        )
+    raise ValueError(f"unknown dataset {dataset_name!r}")

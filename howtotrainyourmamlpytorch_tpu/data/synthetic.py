@@ -1,0 +1,69 @@
+"""Synthetic episode batches for tests and throughput benchmarks.
+
+Shapes follow the framework's NHWC batch contract:
+``x: [B, n_way, k, H, W, C]`` float32, ``y: [B, n_way, k]`` int32 with
+episode-local labels 0..n_way-1 (reference label remap, ``data.py:499-501``).
+"""
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def synthetic_batch(
+    batch_size: int,
+    n_way: int,
+    k_shot: int,
+    num_target: int,
+    image_shape: Tuple[int, int, int],
+    seed: int = 0,
+) -> Dict[str, np.ndarray]:
+    h, w, c = image_shape
+    rng = np.random.RandomState(seed)
+    labels = np.broadcast_to(
+        np.arange(n_way, dtype=np.int32)[None, :, None], (batch_size, n_way, 1)
+    )
+    return {
+        "x_support": rng.rand(batch_size, n_way, k_shot, h, w, c).astype(np.float32),
+        "y_support": np.ascontiguousarray(
+            np.broadcast_to(labels, (batch_size, n_way, k_shot))
+        ).astype(np.int32),
+        "x_target": rng.rand(batch_size, n_way, num_target, h, w, c).astype(np.float32),
+        "y_target": np.ascontiguousarray(
+            np.broadcast_to(labels, (batch_size, n_way, num_target))
+        ).astype(np.int32),
+    }
+
+
+def learnable_synthetic_batch(
+    batch_size: int,
+    n_way: int,
+    k_shot: int,
+    num_target: int,
+    image_shape: Tuple[int, int, int],
+    seed: int = 0,
+) -> Dict[str, np.ndarray]:
+    """A batch where each episode class has a distinct mean image, so a model
+    that adapts can actually separate the classes — used by learning smoke
+    tests (analogue of SURVEY.md §4 'val accuracy climbing')."""
+    h, w, c = image_shape
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(batch_size, n_way, h, w, c).astype(np.float32)
+
+    def draw(k):
+        noise = 0.1 * rng.randn(batch_size, n_way, k, h, w, c).astype(np.float32)
+        return np.clip(protos[:, :, None] + noise, 0.0, 1.0)
+
+    labels = np.broadcast_to(
+        np.arange(n_way, dtype=np.int32)[None, :, None], (batch_size, n_way, 1)
+    )
+    return {
+        "x_support": draw(k_shot),
+        "y_support": np.ascontiguousarray(
+            np.broadcast_to(labels, (batch_size, n_way, k_shot))
+        ).astype(np.int32),
+        "x_target": draw(num_target),
+        "y_target": np.ascontiguousarray(
+            np.broadcast_to(labels, (batch_size, n_way, num_target))
+        ).astype(np.int32),
+    }

@@ -1,0 +1,2 @@
+from . import checkpoint, storage  # noqa: F401
+from .runner import ExperimentRunner  # noqa: F401

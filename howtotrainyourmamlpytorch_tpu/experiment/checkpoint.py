@@ -1,0 +1,102 @@
+"""Full-train-state checkpointing.
+
+Fixes the reference's resume gap (SURVEY.md §5.4): its ``save_model`` writes
+only ``state_dict()`` — outer Adam moments and scheduler position are lost on
+resume (reference ``few_shot_learning_system.py:409-432``). Here the checkpoint
+is the complete ``TrainState`` pytree (params + BN state + learned inner-opt
+hyperparams + outer optimizer state + step counter) plus runner bookkeeping
+(epoch, data cursor, best-val tracking), serialized with flax msgpack.
+
+File naming mirrors the reference ("{name}_{idx}" with idx = epoch or
+'latest'); ``max_models_to_save`` rotation matches ``config.yaml:12``.
+"""
+
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+from ..core.train_state import TrainState
+
+MODEL_NAME = "train_model"
+
+
+def _path(save_dir: str, idx) -> str:
+    return os.path.join(save_dir, f"{MODEL_NAME}_{idx}")
+
+
+def _serialize(state: TrainState, bookkeeping: Dict[str, Any]) -> bytes:
+    payload = {
+        "network": serialization.to_bytes(jax.tree.map(np.asarray, state)),
+        "bookkeeping": bookkeeping,
+    }
+    return serialization.msgpack_serialize(payload)
+
+
+def _write_atomic(target: str, blob: bytes) -> None:
+    tmp = target + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, target)  # atomic: preemption-safe (SURVEY.md §5.3)
+
+
+def save_named(save_dir: str, state: TrainState, bookkeeping: Dict[str, Any], idx) -> str:
+    """Write a single checkpoint file under any idx (e.g. 'best')."""
+    path = _path(save_dir, idx)
+    _write_atomic(path, _serialize(state, bookkeeping))
+    return path
+
+
+def save_checkpoint(
+    save_dir: str,
+    state: TrainState,
+    bookkeeping: Dict[str, Any],
+    epoch: int,
+    max_models_to_save: int = 5,
+) -> str:
+    blob = _serialize(state, bookkeeping)
+    path = _path(save_dir, epoch)
+    for target in (path, _path(save_dir, "latest")):
+        _write_atomic(target, blob)
+    _rotate(save_dir, max_models_to_save)
+    return path
+
+
+def _rotate(save_dir: str, keep: int) -> None:
+    pattern = re.compile(rf"^{MODEL_NAME}_(\d+)$")
+    epochs = sorted(
+        int(m.group(1))
+        for name in os.listdir(save_dir)
+        if (m := pattern.match(name))
+    )
+    for epoch in epochs[:-keep] if keep > 0 else []:
+        os.remove(_path(save_dir, epoch))
+
+
+def load_checkpoint(
+    save_dir: str, idx, template_state: TrainState
+) -> Tuple[TrainState, Dict[str, Any]]:
+    """``idx`` is an epoch number or 'latest' (reference load_model API,
+    ``few_shot_learning_system.py:419-432``). ``template_state`` supplies the
+    pytree structure (an ``init_train_state()`` result)."""
+    with open(_path(save_dir, idx), "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    template = jax.tree.map(np.asarray, template_state)
+    state = serialization.from_bytes(template, payload["network"])
+    return TrainState(*state), payload["bookkeeping"]
+
+
+def latest_checkpoint_exists(save_dir: str) -> bool:
+    return os.path.exists(_path(save_dir, "latest"))
+
+
+def available_epochs(save_dir: str):
+    pattern = re.compile(rf"^{MODEL_NAME}_(\d+)$")
+    if not os.path.isdir(save_dir):
+        return []
+    return sorted(
+        int(m.group(1)) for name in os.listdir(save_dir) if (m := pattern.match(name))
+    )

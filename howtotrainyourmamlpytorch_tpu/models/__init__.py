@@ -1,0 +1,6 @@
+from . import layers  # noqa: F401
+from .densenet import build_densenet  # noqa: F401
+from .model import Model  # noqa: F401
+from .registry import MODEL_NAMES, build_model  # noqa: F401
+from .resnet import build_resnet  # noqa: F401
+from .vgg import build_vgg  # noqa: F401

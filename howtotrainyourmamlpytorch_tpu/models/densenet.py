@@ -1,0 +1,125 @@
+"""Stem-less DenseNet-BC for few-shot learning (reference ``models.py:153-220``).
+
+No first convolution: the dense blocks start directly from the input channels
+(``models.py:180``). ``growth_rate=8``, ``bn_size=2``; densenet-8/12 map to
+``block_config=[2]*4 / [3]*4`` (reference ``few_shot_learning_system.py:74-77``).
+Each dense layer (torchvision ``_DenseLayer``) is
+BN -> ReLU -> Conv1x1(bn_size*growth) -> BN -> ReLU -> Conv3x3(growth, pad 1),
+output concatenated onto the running feature stack; transitions are
+BN -> ReLU -> Conv1x1(features//2) -> AvgPool2x2. Final BN -> ReLU -> global
+avg pool -> Linear (zero bias, ``models.py:211-212``); convs use
+kaiming-normal fan_in (torch ``kaiming_normal_`` default, ``models.py:206-207``).
+"""
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+from .model import Model
+
+
+def _init_dense_layer(key, cin, growth_rate, bn_size):
+    k1, k2 = jax.random.split(key)
+    bottleneck = bn_size * growth_rate
+    n1_p, n1_s = layers.init_batch_norm(cin)
+    n2_p, n2_s = layers.init_batch_norm(bottleneck)
+    params = {
+        "norm1": n1_p,
+        "conv1": layers.init_conv(k1, 1, 1, cin, bottleneck, bias=False, init="kaiming_normal_fan_in"),
+        "norm2": n2_p,
+        "conv2": layers.init_conv(k2, 3, 3, bottleneck, growth_rate, bias=False, init="kaiming_normal_fan_in"),
+    }
+    state = {"norm1": n1_s, "norm2": n2_s}
+    return params, state
+
+
+def _apply_dense_layer(params, state, x, use_batch_stats, update_running):
+    out, n1_s = layers.batch_norm(params["norm1"], state["norm1"], x, use_batch_stats, update_running)
+    out = layers.relu(out)
+    out = layers.conv2d(params["conv1"], out, stride=1, padding=0)
+    out, n2_s = layers.batch_norm(params["norm2"], state["norm2"], out, use_batch_stats, update_running)
+    out = layers.relu(out)
+    out = layers.conv2d(params["conv2"], out, stride=1, padding=1)
+    return out, {"norm1": n1_s, "norm2": n2_s}
+
+
+def build_densenet(
+    image_shape: Tuple[int, int, int],
+    num_classes: int,
+    block_config: Sequence[int] = (3, 3, 3, 3),
+    growth_rate: int = 8,
+    bn_size: int = 2,
+) -> Model:
+    h, w, c = image_shape
+
+    def init(key):
+        params, state = {}, {}
+        num_features = c
+        n_keys = sum(block_config) + len(block_config)
+        keys = jax.random.split(key, n_keys)
+        ki = 0
+        for i, num_layers in enumerate(block_config):
+            block_p, block_s = {}, {}
+            for li in range(num_layers):
+                lp, ls = _init_dense_layer(
+                    keys[ki], num_features + li * growth_rate, growth_rate, bn_size
+                )
+                ki += 1
+                block_p[f"layer_{li}"] = lp
+                block_s[f"layer_{li}"] = ls
+            params[f"denseblock{i + 1}"] = block_p
+            state[f"denseblock{i + 1}"] = block_s
+            num_features = num_features + num_layers * growth_rate
+            if i != len(block_config) - 1:
+                tn_p, tn_s = layers.init_batch_norm(num_features)
+                params[f"transition{i + 1}"] = {
+                    "norm": tn_p,
+                    "conv": layers.init_conv(
+                        keys[ki], 1, 1, num_features, num_features // 2,
+                        bias=False, init="kaiming_normal_fan_in",
+                    ),
+                }
+                state[f"transition{i + 1}"] = {"norm": tn_s}
+                ki += 1
+                num_features = num_features // 2
+        n5_p, n5_s = layers.init_batch_norm(num_features)
+        params["norm5"] = n5_p
+        state["norm5"] = n5_s
+        params["classifier"] = layers.init_linear(
+            keys[-1], num_features, num_classes, zero_bias=True
+        )
+        return params, state
+
+    def apply(params, state, x, *, use_batch_stats=True, update_running=False):
+        new_state = {}
+        for i, num_layers in enumerate(block_config):
+            bname = f"denseblock{i + 1}"
+            block_s = {}
+            for li in range(num_layers):
+                lname = f"layer_{li}"
+                new_feat, ls = _apply_dense_layer(
+                    params[bname][lname], state[bname][lname], x,
+                    use_batch_stats, update_running,
+                )
+                block_s[lname] = ls
+                x = jnp.concatenate([x, new_feat], axis=-1)
+            new_state[bname] = block_s
+            if i != len(block_config) - 1:
+                tname = f"transition{i + 1}"
+                x, tn_s = layers.batch_norm(
+                    params[tname]["norm"], state[tname]["norm"], x,
+                    use_batch_stats, update_running,
+                )
+                x = layers.relu(x)
+                x = layers.conv2d(params[tname]["conv"], x, stride=1, padding=0)
+                x = layers.avg_pool(x)
+                new_state[tname] = {"norm": tn_s}
+        x, n5_s = layers.batch_norm(params["norm5"], state["norm5"], x, use_batch_stats, update_running)
+        new_state["norm5"] = n5_s
+        x = layers.relu(x)
+        x = layers.global_avg_pool(x)
+        return layers.linear(params["classifier"], x), new_state
+
+    return Model(init=init, apply=apply, name="densenet")

@@ -1,0 +1,219 @@
+"""Functional NN primitives over plain pytrees (NHWC, TPU-native layout).
+
+Every layer is a pair of pure functions: an ``init_*`` returning a params dict
+(and, for batch-norm, a state dict) and an ``apply``-style function. Models are
+nested dicts of these. This replaces the reference's ``nn.Module`` layers that
+``higher`` monkey-patches into functional form (reference ``models.py``) — in
+JAX the functional form is the native one, so the inner-loop fast weights are
+just "a different params pytree" and second-order autodiff through batch-norm
+is ordinary XLA autodiff.
+
+Initializer distributions intentionally match the PyTorch defaults the
+reference relies on (torch Conv2d/Linear default = kaiming-uniform with
+a=sqrt(5); reference ResNet uses kaiming-normal fan_out, ``models.py:98-103``;
+DenseNet uses kaiming-normal fan_in, ``models.py:205-212``) so accuracy parity
+runs start from the same distribution family.
+
+Layout note: we use NHWC activations and HWIO conv kernels — the layout the
+TPU's MXU/convolution units natively tile — rather than translating the
+reference's NCHW. Linear flatten order therefore differs from torch (HWC vs
+CHW); this is a fixed permutation of the first linear layer and has no effect
+on learning dynamics.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# Initializers (torch-matching distribution families)
+# ---------------------------------------------------------------------------
+
+
+def _conv_fans(shape_hwio):
+    kh, kw, cin, cout = shape_hwio
+    receptive = kh * kw
+    return cin * receptive, cout * receptive
+
+
+def kaiming_uniform_conv(key, shape_hwio, dtype=jnp.float32):
+    """torch Conv2d default: kaiming_uniform_(a=sqrt(5)) => U(-1/sqrt(fan_in), ...)."""
+    fan_in, _ = _conv_fans(shape_hwio)
+    gain = math.sqrt(2.0 / (1.0 + 5.0))  # a = sqrt(5)
+    bound = gain * math.sqrt(3.0 / fan_in)
+    return jax.random.uniform(key, shape_hwio, dtype, minval=-bound, maxval=bound)
+
+
+def kaiming_normal_conv(key, shape_hwio, mode="fan_out", dtype=jnp.float32):
+    fan_in, fan_out = _conv_fans(shape_hwio)
+    fan = fan_out if mode == "fan_out" else fan_in
+    std = math.sqrt(2.0 / fan)
+    return std * jax.random.normal(key, shape_hwio, dtype)
+
+
+def uniform_fan_in_bias(key, fan_in, n, dtype=jnp.float32):
+    bound = 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0
+    return jax.random.uniform(key, (n,), dtype, minval=-bound, maxval=bound)
+
+
+def kaiming_uniform_linear(key, shape_io, dtype=jnp.float32):
+    fan_in = shape_io[0]
+    gain = math.sqrt(2.0 / 6.0)
+    bound = gain * math.sqrt(3.0 / fan_in)
+    return jax.random.uniform(key, shape_io, dtype, minval=-bound, maxval=bound)
+
+
+# ---------------------------------------------------------------------------
+# Conv / Linear
+# ---------------------------------------------------------------------------
+
+_CONV_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def init_conv(key, kh, kw, cin, cout, bias=True, init="torch_default"):
+    wkey, bkey = jax.random.split(key)
+    shape = (kh, kw, cin, cout)
+    if init == "torch_default":
+        w = kaiming_uniform_conv(wkey, shape)
+    elif init == "kaiming_normal_fan_out":
+        w = kaiming_normal_conv(wkey, shape, mode="fan_out")
+    elif init == "kaiming_normal_fan_in":
+        w = kaiming_normal_conv(wkey, shape, mode="fan_in")
+    else:
+        raise ValueError(init)
+    params = {"w": w}
+    if bias:
+        fan_in, _ = _conv_fans(shape)
+        params["b"] = uniform_fan_in_bias(bkey, fan_in, cout)
+    return params
+
+
+def conv2d(params, x, stride=1, padding=0):
+    """3x3/1x1 conv, NHWC. ``padding`` is symmetric int (torch-style)."""
+    pad = ((padding, padding), (padding, padding)) if isinstance(padding, int) else padding
+    out = lax.conv_general_dilated(
+        x,
+        params["w"],
+        window_strides=(stride, stride),
+        padding=pad,
+        dimension_numbers=_CONV_DIMS,
+    )
+    if "b" in params:
+        out = out + params["b"]
+    return out
+
+
+def init_linear(key, cin, cout, init="torch_default", zero_bias=False):
+    wkey, bkey = jax.random.split(key)
+    w = kaiming_uniform_linear(wkey, (cin, cout))
+    b = (
+        jnp.zeros((cout,))
+        if zero_bias
+        else uniform_fan_in_bias(bkey, cin, cout)
+    )
+    return {"w": w, "b": b}
+
+
+def linear(params, x):
+    return x @ params["w"] + params["b"]
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm
+# ---------------------------------------------------------------------------
+
+
+def init_batch_norm(c):
+    params = {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+    state = {"mean": jnp.zeros((c,)), "var": jnp.ones((c,)), "count": jnp.zeros(())}
+    return params, state
+
+
+def batch_norm(
+    params,
+    state,
+    x,
+    use_batch_stats: bool = True,
+    update_running: bool = False,
+    momentum: float = 0.1,
+    eps: float = 1e-5,
+):
+    """Functional batch-norm over NHWC (reduce N,H,W) or NC input (reduce N).
+
+    The reference runs *both* the inner loop and evaluation in train mode
+    (transductive BN, reference ``few_shot_learning_system.py:344,388``), so
+    normalization always uses the current batch's statistics. Running stats
+    remain at their init values in the standard training path — exactly as in
+    the reference, where forward passes go through ``higher``'s functional
+    copies and the meta-model's buffers are never updated. They exist for API
+    completeness (``update_running=True`` + ``use_batch_stats=False`` gives
+    conventional BN for non-transductive experiments).
+    """
+    axes = tuple(range(x.ndim - 1))
+    if use_batch_stats:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    else:
+        mean, var = state["mean"], state["var"]
+    inv = lax.rsqrt(var + eps)
+    out = (x - mean) * inv * params["scale"] + params["bias"]
+    if update_running and use_batch_stats:
+        n = x.size // x.shape[-1]
+        unbiased = var * (n / max(n - 1, 1))
+        new_state = {
+            "mean": (1 - momentum) * state["mean"] + momentum * mean,
+            "var": (1 - momentum) * state["var"] + momentum * unbiased,
+            "count": state["count"] + 1,
+        }
+    else:
+        new_state = state
+    return out, new_state
+
+
+# ---------------------------------------------------------------------------
+# Pooling / activations
+# ---------------------------------------------------------------------------
+
+
+def max_pool(x, window=2, stride=2):
+    """MaxPool2d(window, stride, pad=0), floor mode — matches torch default."""
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID",
+    )
+
+
+def avg_pool(x, window=2, stride=2):
+    summed = lax.reduce_window(
+        x,
+        0.0,
+        lax.add,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID",
+    )
+    return summed / (window * window)
+
+
+def global_avg_pool(x):
+    """AdaptiveAvgPool2d((1,1)) + flatten: NHWC -> NC."""
+    return jnp.mean(x, axis=(1, 2))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def flatten(x):
+    return x.reshape((x.shape[0], -1))

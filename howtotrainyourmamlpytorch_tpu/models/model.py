@@ -1,0 +1,18 @@
+"""The Model container: a pair of pure functions over pytrees.
+
+``init(key) -> (params, state)`` and
+``apply(params, state, x, *, use_batch_stats, update_running) -> (logits, state')``.
+
+``params`` are the meta-learned weights (the inner loop produces fast-weight
+variants of this same pytree); ``state`` holds batch-norm running statistics,
+which the reference tracks but never consults for normalization (transductive
+BN everywhere — reference ``few_shot_learning_system.py:388``).
+"""
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+
+class Model(NamedTuple):
+    init: Callable[..., Tuple[Any, Any]]
+    apply: Callable[..., Tuple[Any, Any]]
+    name: str = "model"

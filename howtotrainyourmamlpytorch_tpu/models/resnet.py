@@ -1,0 +1,112 @@
+"""Stem-less ResNet for few-shot learning (reference ``models.py:60-150``).
+
+The reference's ResNet drops the ImageNet stem entirely: ``inplanes`` starts at
+the *input channel count* (``models.py:83``) and the network is 4 stages of
+torchvision ``BasicBlock`` at widths 32/64/128/256, each with stride 2
+(``models.py:84-93``), then global average pool -> Linear. resnet-4/8/12 map to
+``layers=[1,1,1,1] / [2,2,2,2] / [3,3,3,3]`` (reference
+``few_shot_learning_system.py:63-68``).
+
+Init parity: kaiming-normal fan_out for convs, unit/zero BN
+(``models.py:98-103``), optional zero-init of each block's second BN scale
+(``models.py:109-114``); the final Linear keeps the torch default init.
+"""
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+from .model import Model
+
+_STAGE_WIDTHS = (32, 64, 128, 256)
+
+
+def _init_basic_block(key, cin, planes, stride):
+    k1, k2, k3 = jax.random.split(key, 3)
+    bn1_p, bn1_s = layers.init_batch_norm(planes)
+    bn2_p, bn2_s = layers.init_batch_norm(planes)
+    params = {
+        "conv1": layers.init_conv(k1, 3, 3, cin, planes, bias=False, init="kaiming_normal_fan_out"),
+        "bn1": bn1_p,
+        "conv2": layers.init_conv(k2, 3, 3, planes, planes, bias=False, init="kaiming_normal_fan_out"),
+        "bn2": bn2_p,
+    }
+    state = {"bn1": bn1_s, "bn2": bn2_s}
+    if stride != 1 or cin != planes:
+        dbn_p, dbn_s = layers.init_batch_norm(planes)
+        params["downsample"] = {
+            "conv": layers.init_conv(k3, 1, 1, cin, planes, bias=False, init="kaiming_normal_fan_out"),
+            "bn": dbn_p,
+        }
+        state["downsample"] = {"bn": dbn_s}
+    return params, state
+
+
+def _apply_basic_block(params, state, x, stride, use_batch_stats, update_running):
+    identity = x
+    out = layers.conv2d(params["conv1"], x, stride=stride, padding=1)
+    out, bn1_s = layers.batch_norm(params["bn1"], state["bn1"], out, use_batch_stats, update_running)
+    out = layers.relu(out)
+    out = layers.conv2d(params["conv2"], out, stride=1, padding=1)
+    out, bn2_s = layers.batch_norm(params["bn2"], state["bn2"], out, use_batch_stats, update_running)
+    new_state = {"bn1": bn1_s, "bn2": bn2_s}
+    if "downsample" in params:
+        identity = layers.conv2d(params["downsample"]["conv"], x, stride=stride, padding=0)
+        identity, dbn_s = layers.batch_norm(
+            params["downsample"]["bn"], state["downsample"]["bn"], identity,
+            use_batch_stats, update_running,
+        )
+        new_state["downsample"] = {"bn": dbn_s}
+    return layers.relu(out + identity), new_state
+
+
+def build_resnet(
+    image_shape: Tuple[int, int, int],
+    num_classes: int,
+    blocks_per_stage: Sequence[int] = (1, 1, 1, 1),
+    zero_init_residual: bool = False,
+) -> Model:
+    h, w, c = image_shape
+
+    def init(key):
+        params, state = {}, {}
+        cin = c
+        n_blocks = sum(blocks_per_stage)
+        keys = jax.random.split(key, n_blocks + 1)
+        ki = 0
+        for si, (planes, n) in enumerate(zip(_STAGE_WIDTHS, blocks_per_stage)):
+            stage_p, stage_s = {}, {}
+            for bi in range(n):
+                stride = 2 if bi == 0 else 1
+                bp, bs = _init_basic_block(keys[ki], cin, planes, stride)
+                ki += 1
+                if zero_init_residual:
+                    bp["bn2"]["scale"] = jnp.zeros_like(bp["bn2"]["scale"])
+                stage_p[f"block_{bi}"] = bp
+                stage_s[f"block_{bi}"] = bs
+                cin = planes
+            params[f"layer{si + 1}"] = stage_p
+            state[f"layer{si + 1}"] = stage_s
+        params["fc"] = layers.init_linear(keys[-1], _STAGE_WIDTHS[-1], num_classes)
+        return params, state
+
+    def apply(params, state, x, *, use_batch_stats=True, update_running=False):
+        new_state = {}
+        for si, n in enumerate(blocks_per_stage):
+            lname = f"layer{si + 1}"
+            stage_s = {}
+            for bi in range(n):
+                bname = f"block_{bi}"
+                stride = 2 if bi == 0 else 1
+                x, bs = _apply_basic_block(
+                    params[lname][bname], state[lname][bname], x, stride,
+                    use_batch_stats, update_running,
+                )
+                stage_s[bname] = bs
+            new_state[lname] = stage_s
+        x = layers.global_avg_pool(x)
+        return layers.linear(params["fc"], x), new_state
+
+    return Model(init=init, apply=apply, name="resnet")

@@ -1,0 +1,75 @@
+"""Conv-4 / VGG few-shot backbone (reference ``models.py:11-55``).
+
+``num_stages`` x [Conv3x3(cnn_num_filters, pad=1, stride 1 if max_pooling else
+2) -> BatchNorm -> LeakyReLU -> (MaxPool 2x2 if max_pooling)] then flatten ->
+Linear(num_classes). The reference infers the flatten width by running a dummy
+batch (``models.py:46-48``); here we compute it with ``jax.eval_shape`` — same
+effect, no FLOPs, no tracing surprises.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+from .model import Model
+
+
+def build_vgg(
+    image_shape: Tuple[int, int, int],
+    num_classes: int,
+    num_stages: int = 4,
+    cnn_num_filters: int = 64,
+    max_pooling: bool = True,
+    conv_padding: bool = True,
+    norm_layer: str = "batch_norm",
+) -> Model:
+    if norm_layer != "batch_norm":
+        raise ValueError("only batch_norm is supported (reference models.py:38-41)")
+    h, w, c = image_shape
+    conv_stride = 1 if max_pooling else 2
+    pad = 1 if conv_padding else 0
+
+    def stem(params, state, x, use_batch_stats, update_running):
+        new_state = {}
+        for i in range(num_stages):
+            name = f"stage_{i}"
+            p = params[name]
+            x = layers.conv2d(p["conv"], x, stride=conv_stride, padding=pad)
+            x, bn_state = layers.batch_norm(
+                p["bn"], state[name]["bn"], x, use_batch_stats, update_running
+            )
+            new_state[name] = {"bn": bn_state}
+            x = layers.leaky_relu(x)
+            if max_pooling:
+                x = layers.max_pool(x)
+        return x, new_state
+
+    def init(key):
+        params, state = {}, {}
+        cin = c
+        keys = jax.random.split(key, num_stages + 1)
+        for i in range(num_stages):
+            bn_p, bn_s = layers.init_batch_norm(cnn_num_filters)
+            params[f"stage_{i}"] = {
+                "conv": layers.init_conv(keys[i], 3, 3, cin, cnn_num_filters),
+                "bn": bn_p,
+            }
+            state[f"stage_{i}"] = {"bn": bn_s}
+            cin = cnn_num_filters
+        feat_shape = jax.eval_shape(
+            lambda p, s: stem(p, s, jnp.zeros((1, h, w, c)), True, False)[0],
+            params,
+            state,
+        ).shape
+        flat = int(jnp.prod(jnp.array(feat_shape[1:])))
+        params["fc"] = layers.init_linear(keys[-1], flat, num_classes)
+        return params, state
+
+    def apply(params, state, x, *, use_batch_stats=True, update_running=False):
+        x, new_state = stem(params, state, x, use_batch_stats, update_running)
+        x = layers.flatten(x)
+        return layers.linear(params["fc"], x), new_state
+
+    return Model(init=init, apply=apply, name="vgg")

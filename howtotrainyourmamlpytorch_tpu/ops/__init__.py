@@ -1,0 +1,2 @@
+from . import inner_optim, losses, msl  # noqa: F401
+from .inner_optim import InnerOptimizer, build_inner_optimizer  # noqa: F401

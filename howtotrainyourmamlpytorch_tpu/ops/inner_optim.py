@@ -1,0 +1,195 @@
+"""Differentiable inner-loop optimizers as pure pytree functions.
+
+The reference reaches for ``higher``'s monkey-patched differentiable optimizers
+(``higher.optim``, reference ``few_shot_learning_system.py:97-110,226-237``) to
+make the inner-loop update a node in the meta-gradient graph. In JAX an
+optimizer update is already a pure function, so "differentiable optimizer" is
+just an ``update`` whose outputs are differentiable w.r.t. its inputs — no
+machinery needed. Second-order meta-gradients fall out of ``jax.grad`` over the
+whole rollout.
+
+Semantics match ``torch.optim`` SGD / Adam / Rprop step math exactly (the
+classes the reference instantiates from config, ``config.yaml:70-85``), with
+the LSLR generalization: hyperparameters are *per parameter tensor* pytrees
+(one scalar lr — and for Adam one scalar beta1/beta2 — per leaf, mirroring the
+reference's one-param-group-per-tensor trick at
+``few_shot_learning_system.py:94-107``), and they are ordinary differentiable
+inputs so the outer loop can learn them.
+
+Hyperparameter projection (applied after each outer step, reference
+``few_shot_learning_system.py:323-329``): lr >= 1e-4; Adam betas in
+[1e-4, 0.99].
+"""
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.trees import tree_scalars_like
+
+
+class InnerOptimizer(NamedTuple):
+    """A differentiable optimizer: pure init/update over pytrees.
+
+    ``init_hparams(params)`` builds the learnable per-tensor hyperparameter
+    pytree; ``init_state(params, hparams)`` the (differentiable) optimizer
+    state; ``update(grads, state, params, hparams)`` one step;
+    ``project_hparams`` the post-outer-step clamp.
+    """
+
+    name: str
+    init_hparams: Callable[[Any], Any]
+    init_state: Callable[[Any, Any], Any]
+    update: Callable[[Any, Any, Any, Any], Any]
+    project_hparams: Callable[[Any], Any]
+
+
+# ---------------------------------------------------------------------------
+# SGD (torch.optim.SGD, no momentum — reference `gd` preset, config.yaml:70-73)
+# ---------------------------------------------------------------------------
+
+
+def sgd(lr: float = 0.1) -> InnerOptimizer:
+    def init_hparams(params):
+        return {"lr": tree_scalars_like(params, lr)}
+
+    def init_state(params, hparams):
+        return ()
+
+    def update(grads, state, params, hparams):
+        new_params = jax.tree.map(lambda p, g, a: p - a * g, params, grads, hparams["lr"])
+        return new_params, state
+
+    def project_hparams(hparams):
+        return {"lr": jax.tree.map(lambda a: jnp.maximum(a, 1e-4), hparams["lr"])}
+
+    return InnerOptimizer("sgd", init_hparams, init_state, update, project_hparams)
+
+
+# ---------------------------------------------------------------------------
+# Adam (torch.optim.Adam step math, eps=1e-8; reference `adam` preset
+# config.yaml:80-85 with learnable per-tensor betas)
+# ---------------------------------------------------------------------------
+
+
+def adam(lr: float = 0.1, beta1: float = 0.5, beta2: float = 0.5, eps: float = 1e-8) -> InnerOptimizer:
+    def init_hparams(params):
+        return {
+            "lr": tree_scalars_like(params, lr),
+            "beta1": tree_scalars_like(params, beta1),
+            "beta2": tree_scalars_like(params, beta2),
+        }
+
+    def init_state(params, hparams):
+        return {
+            "step": tree_scalars_like(params, 0.0),
+            "exp_avg": jax.tree.map(jnp.zeros_like, params),
+            "exp_avg_sq": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update(grads, state, params, hparams):
+        def leaf(p, g, m, v, t, a, b1, b2):
+            t = t + 1.0
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            bc1 = 1.0 - b1**t
+            bc2 = 1.0 - b2**t
+            denom = jnp.sqrt(v) / jnp.sqrt(bc2) + eps
+            p = p - (a / bc1) * m / denom
+            return p, m, v, t
+
+        treedef = jax.tree.structure(params)
+        flat = [
+            leaf(*leaves)
+            for leaves in zip(
+                jax.tree.leaves(params),
+                jax.tree.leaves(grads),
+                jax.tree.leaves(state["exp_avg"]),
+                jax.tree.leaves(state["exp_avg_sq"]),
+                jax.tree.leaves(state["step"]),
+                jax.tree.leaves(hparams["lr"]),
+                jax.tree.leaves(hparams["beta1"]),
+                jax.tree.leaves(hparams["beta2"]),
+            )
+        ]
+        unflatten = lambda i: jax.tree.unflatten(treedef, [t[i] for t in flat])
+        new_params = unflatten(0)
+        new_state = {"exp_avg": unflatten(1), "exp_avg_sq": unflatten(2), "step": unflatten(3)}
+        return new_params, new_state
+
+    def project_hparams(hparams):
+        clip_beta = lambda b: jnp.clip(b, 1e-4, 0.99)
+        return {
+            "lr": jax.tree.map(lambda a: jnp.maximum(a, 1e-4), hparams["lr"]),
+            "beta1": jax.tree.map(clip_beta, hparams["beta1"]),
+            "beta2": jax.tree.map(clip_beta, hparams["beta2"]),
+        }
+
+    return InnerOptimizer("adam", init_hparams, init_state, update, project_hparams)
+
+
+# ---------------------------------------------------------------------------
+# Rprop (torch.optim.Rprop step math; reference `rprop` preset config.yaml:75-78)
+# ---------------------------------------------------------------------------
+
+
+def rprop(
+    lr: float = 0.1,
+    eta_minus: float = 0.5,
+    eta_plus: float = 1.2,
+    step_size_min: float = 1e-6,
+    step_size_max: float = 50.0,
+) -> InnerOptimizer:
+    def init_hparams(params):
+        return {"lr": tree_scalars_like(params, lr)}
+
+    def init_state(params, hparams):
+        # torch initializes the per-element step size to lr on first use.
+        return {
+            "prev": jax.tree.map(jnp.zeros_like, params),
+            "step_size": jax.tree.map(
+                lambda p, a: jnp.full_like(p, 1.0) * a, params, hparams["lr"]
+            ),
+        }
+
+    def update(grads, state, params, hparams):
+        def leaf(p, g, prev, step_size):
+            sign = jnp.sign(g * prev)
+            factor = jnp.where(sign > 0, eta_plus, jnp.where(sign < 0, eta_minus, 1.0))
+            step_size = jnp.clip(step_size * factor, step_size_min, step_size_max)
+            g_eff = jnp.where(sign < 0, 0.0, g)
+            p = p - jnp.sign(g_eff) * step_size
+            return p, g_eff, step_size
+
+        treedef = jax.tree.structure(params)
+        flat = [
+            leaf(*leaves)
+            for leaves in zip(
+                jax.tree.leaves(params),
+                jax.tree.leaves(grads),
+                jax.tree.leaves(state["prev"]),
+                jax.tree.leaves(state["step_size"]),
+            )
+        ]
+        unflatten = lambda i: jax.tree.unflatten(treedef, [t[i] for t in flat])
+        new_params = unflatten(0)
+        new_state = {"prev": unflatten(1), "step_size": unflatten(2)}
+        return new_params, new_state
+
+    def project_hparams(hparams):
+        return {"lr": jax.tree.map(lambda a: jnp.maximum(a, 1e-4), hparams["lr"])}
+
+    return InnerOptimizer("rprop", init_hparams, init_state, update, project_hparams)
+
+
+_BUILDERS = {"sgd": sgd, "gd": sgd, "adam": adam, "rprop": rprop}
+
+
+def build_inner_optimizer(kind: str, **kwargs) -> InnerOptimizer:
+    """Dispatch by name — the reference selects the inner optimizer by config
+    class-path (``few_shot_learning_system.py:87-88``); we keep "inner optimizer
+    as a first-class config axis" with names instead of import paths."""
+    if kind not in _BUILDERS:
+        raise ValueError(f"unknown inner optimizer {kind!r}; expected one of {sorted(_BUILDERS)}")
+    return _BUILDERS[kind](**kwargs)

@@ -1,0 +1,16 @@
+"""Loss and metric primitives."""
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits, labels):
+    """Mean softmax cross-entropy with integer labels (= F.cross_entropy,
+    reference ``few_shot_learning_system.py:223-224``)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def accuracy(logits, labels):
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

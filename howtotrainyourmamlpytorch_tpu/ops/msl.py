@@ -1,0 +1,34 @@
+"""Multi-step-loss (MSL) importance annealing.
+
+Exact re-derivation of the reference schedule
+(``few_shot_learning_system.py:131-151``): weights start uniform ``1/N`` over
+the ``N`` inner steps; each epoch every non-final weight decays by
+``1/(N * multi_step_loss_num_epochs)`` down to a floor of ``0.03/N`` while the
+final-step weight grows symmetrically up to ``1 - (N-1) * 0.03/N``. All
+non-final weights are equal at every epoch, so the loop in the reference
+collapses to the closed form below. Implemented on traced scalars so one
+compiled meta-step program serves every epoch.
+"""
+
+import jax.numpy as jnp
+
+
+def per_step_loss_importance(epoch, num_steps: int, multi_step_loss_num_epochs: int):
+    """Weight vector [num_steps] as a function of the (traced) epoch index."""
+    epoch = jnp.asarray(epoch, jnp.float32)
+    n = float(num_steps)
+    decay_rate = 1.0 / n / multi_step_loss_num_epochs
+    min_non_final = 0.03 / n
+    non_final = jnp.maximum(1.0 / n - epoch * decay_rate, min_non_final)
+    final = jnp.minimum(
+        1.0 / n + epoch * (n - 1.0) * decay_rate,
+        1.0 - (n - 1.0) * min_non_final,
+    )
+    weights = jnp.full((num_steps,), 1.0, jnp.float32) * non_final
+    return weights.at[-1].set(final)
+
+
+def final_step_only(num_steps: int):
+    """The no-MSL weighting: only the last inner step's target loss counts
+    (reference ``few_shot_learning_system.py:246-251``)."""
+    return jnp.zeros((num_steps,), jnp.float32).at[-1].set(1.0)

@@ -1,0 +1,10 @@
+from .mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    initialize_distributed,
+    make_mesh,
+    replicate,
+    replicated,
+    shard_batch,
+)

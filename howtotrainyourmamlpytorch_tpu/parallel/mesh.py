@@ -1,0 +1,76 @@
+"""Device mesh + sharding layer (SPMD over ICI).
+
+The reference is hard-coded single-GPU (``train_maml_system.py:23``); its
+``num_of_gpus`` key only inflates the DataLoader batch (``data.py:589``).
+Here data parallelism is native: the meta-batch (task axis) is sharded over
+the ``dp`` mesh axis with ``NamedSharding``; because the meta-objective is a
+``vmap`` + mean over that axis, XLA partitions the whole second-order program
+across chips and inserts the meta-gradient ``psum`` automatically — the
+collectives ride ICI, no NCCL-style bespoke layer (SURVEY.md §2.11, §5.8).
+``mp`` is exposed for parameter sharding of larger backbones (2D data x model
+mesh API).
+
+Multi-host: ``initialize_distributed`` wraps ``jax.distributed.initialize`` so
+the same program scales over DCN across hosts; on a single host it is a no-op.
+"""
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import ParallelConfig
+
+DATA_AXIS = "dp"
+MODEL_AXIS = "mp"
+
+
+def make_mesh(parallel: Optional[ParallelConfig] = None, devices=None) -> Mesh:
+    parallel = parallel or ParallelConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    mp = max(parallel.mp, 1)
+    dp = parallel.dp if parallel.dp and parallel.dp > 0 else len(devices) // mp
+    if dp * mp > len(devices):
+        raise ValueError(f"mesh {dp}x{mp} needs {dp * mp} devices, have {len(devices)}")
+    grid = np.array(devices[: dp * mp]).reshape(dp, mp)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Tasks of the meta-batch sharded over dp; everything else replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    sharding = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(tree, mesh: Mesh):
+    sharding = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host (DCN) bring-up. On a single host this is a no-op; on a pod
+    slice, call once per host before building the mesh (jax multi-host runtime
+    handles the DCN transport — SURVEY.md §5.8)."""
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,1 @@
+from . import trees, seeding  # noqa: F401

@@ -1,0 +1,29 @@
+"""Seed derivation matching the reference's discipline.
+
+The reference derives a torch seed from a numpy RNG seeded with ``args.seed``
+(reference ``few_shot_learning_system.py:15-25``) and derives per-split episode
+seeds from ``train_seed`` / ``val_seed`` (reference ``data.py:139-149``; note
+the test stream is deliberately seeded from ``val_seed`` — a reference quirk we
+preserve behind a flag). We keep the same numpy-RNG derivation so that the
+"seed 0 experiment" means the same thing, then fold the derived seed into a
+``jax.random`` key for parameter init.
+"""
+
+import jax
+import numpy as np
+
+
+def derive_model_seed(seed: int) -> int:
+    """Reference ``set_torch_seed``: np.RandomState(seed).randint(0, 999999)."""
+    rng = np.random.RandomState(seed=seed)
+    return int(rng.randint(0, 999999))
+
+
+def model_init_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(derive_model_seed(seed))
+
+
+def derive_split_seed(seed: int) -> int:
+    """Reference ``data.py:139-144``: np.RandomState(seed).randint(1, 999999)."""
+    rng = np.random.RandomState(seed=seed)
+    return int(rng.randint(1, 999999))

@@ -1,0 +1,64 @@
+"""Pytree helpers shared across the framework."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tree_scalars_like(tree, value, dtype=jnp.float32):
+    """A tree with the same structure as ``tree`` whose leaves are scalars.
+
+    Used for per-tensor learnable inner-opt hyperparameters (LSLR): the
+    reference creates one optimizer param-group *per parameter tensor*
+    (reference ``few_shot_learning_system.py:94-107``), so each leaf of the
+    parameter tree gets its own scalar lr / beta.
+    """
+    return jax.tree.map(lambda _: jnp.asarray(value, dtype=dtype), tree)
+
+
+def tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_full_like(tree, value):
+    return jax.tree.map(lambda p: jnp.full_like(p, value), tree)
+
+
+def tree_count_params(tree):
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(tree))
+
+
+def tree_clip(tree, lo, hi):
+    return jax.tree.map(lambda p: jnp.clip(p, lo, hi), tree)
+
+
+def tree_to_numpy(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def tree_allclose(a, b, rtol=1e-5, atol=1e-7):
+    leaves_a, treedef_a = jax.tree.flatten(a)
+    leaves_b, treedef_b = jax.tree.flatten(b)
+    if treedef_a != treedef_b:
+        return False
+    return all(
+        np.allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=atol)
+        for x, y in zip(leaves_a, leaves_b)
+    )
+
+
+def named_leaves(tree, prefix=""):
+    """Yield ``(dotted_name, leaf)`` pairs in deterministic traversal order.
+
+    Used for parameter printouts (parity with the reference's named-parameter
+    dump, reference ``few_shot_learning_system.py:116-122``) and for the
+    ``lrs.csv`` column ordering.
+    """
+    if isinstance(tree, dict):
+        for key in sorted(tree.keys()):
+            yield from named_leaves(tree[key], f"{prefix}{key}." if prefix or True else key)
+    elif isinstance(tree, (list, tuple)):
+        for i, item in enumerate(tree):
+            yield from named_leaves(item, f"{prefix}{i}.")
+    else:
+        yield prefix.rstrip("."), tree

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Ablation-grid sweep launcher.
+
+The reference README names a ``launch-all.py`` cluster launcher that is missing
+from its snapshot (reference ``README.md:11``; SURVEY.md §2). This reconstructs
+the capability: the cartesian product of (dataset x n_way/k_shot x backbone x
+inner optimizer x seed) from the reference's published sweep (BASELINE.md),
+run sequentially on this host or emitted as a command list for a scheduler.
+
+Usage:
+    python launch_all.py --dry-run            # print the grid
+    python launch_all.py --select 0 2 5       # run specific jobs
+    python launch_all.py                      # run everything sequentially
+"""
+
+import argparse
+import itertools
+import subprocess
+import sys
+
+GRID = {
+    "episode": [  # (dataset_preset, n_way, k_shot)
+        ("omniglot", 5, 1),
+        ("omniglot", 5, 5),
+        ("omniglot", 20, 1),
+        ("omniglot", 20, 5),
+        ("imagenet", 5, 1),
+        ("imagenet", 5, 5),
+    ],
+    "net": ["vgg", "resnet-4", "resnet-8", "resnet-12", "densenet-8", "densenet-12"],
+    "inner_optim": ["gd", "adam", "rprop"],
+    "seed": [0, 1, 2],
+}
+
+
+def jobs():
+    for (ds, n_way, k_shot), net, opt, seed in itertools.product(
+        GRID["episode"], GRID["net"], GRID["inner_optim"], GRID["seed"]
+    ):
+        name = f"{ds}.{n_way}.{k_shot}.{net}.{opt}.{seed}"
+        overrides = [
+            f"dataset={ds}",
+            f"num_classes_per_set={n_way}",
+            f"num_samples_per_class={k_shot}",
+            f"net={net}",
+            f"inner_optim={opt}",
+            f"seed={seed}",
+            f"train_seed={seed}",
+            f"val_seed={seed}",
+            f"experiment_name={name}",
+        ]
+        yield name, overrides
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dry-run", action="store_true")
+    parser.add_argument("--select", nargs="+", type=int, default=None)
+    # key=value overrides applied to every job are accepted anywhere on the
+    # command line; split them off before argparse so --select's greedy int
+    # list can't swallow them.
+    if argv is None:
+        argv = sys.argv[1:]
+    extra = [a for a in argv if "=" in a and not a.startswith("-")]
+    args = parser.parse_args([a for a in argv if a not in extra])
+    args.extra = extra
+
+    all_jobs = list(jobs())
+    selected = (
+        [all_jobs[i] for i in args.select] if args.select is not None else all_jobs
+    )
+    for i, (name, overrides) in enumerate(selected):
+        cmd = [sys.executable, "train_maml_system.py"] + overrides + (args.extra or [])
+        print(f"[{i + 1}/{len(selected)}] {name}: {' '.join(cmd)}")
+        if not args.dry_run:
+            subprocess.run(cmd, check=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

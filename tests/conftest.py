@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+imports, so the same pjit/sharding code paths used on a TPU pod slice are
+exercised on any machine (SURVEY.md §4 'distributed without a cluster')."""
+
+import os
+
+# Hard-set (not setdefault): the surrounding environment may point JAX at a
+# remote TPU (JAX_PLATFORMS=axon); tests must always run on local CPU devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# A site hook may have imported jax before this conftest (capturing
+# JAX_PLATFORMS from the environment), so set the config directly too.
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: repeated test runs skip recompiles (this box
+# has a single CPU core; XLA compiles dominate the suite otherwise).
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,49 @@
+"""Checkpoint round-trip: the FULL train state (params + opt state + learned
+hyperparams + step) survives save/load exactly — fixing the reference's
+optimizer-state resume gap (SURVEY.md §5.4)."""
+
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+from howtotrainyourmamlpytorch_tpu.utils.trees import tree_allclose
+
+from tests.test_maml_core import TINY_SHAPE, _as_jnp, tiny_batch, tiny_config, tiny_linear_model
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+
+
+def test_roundtrip_exact(tmp_path):
+    cfg = tiny_config()
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    for i in range(3):
+        state, _ = system.train_step(state, _as_jnp(tiny_batch(seed=i)))
+    book = {"epoch": 2, "best_val_accuracy": 0.5, "best_val_epoch": 1}
+    ckpt.save_checkpoint(str(tmp_path), state, book, epoch=2)
+
+    template = system.init_train_state()
+    restored, book2 = ckpt.load_checkpoint(str(tmp_path), "latest", template)
+    assert book2 == book
+    assert tree_allclose(restored.params, state.params, rtol=0, atol=0)
+    assert tree_allclose(restored.opt_state, state.opt_state, rtol=0, atol=0)
+    assert tree_allclose(restored.inner_hparams, state.inner_hparams, rtol=0, atol=0)
+    assert int(restored.step) == int(state.step)
+
+    # resumed training continues identically to uninterrupted training
+    b = _as_jnp(tiny_batch(seed=77))
+    s_cont, out_cont = system.train_step(state, b)
+    s_res, out_res = system.train_step(restored, b)
+    np.testing.assert_allclose(float(out_cont.loss), float(out_res.loss), rtol=1e-6)
+    assert tree_allclose(s_cont.params, s_res.params, rtol=1e-6, atol=1e-7)
+
+
+def test_rotation_keeps_max_models(tmp_path):
+    cfg = tiny_config()
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    for epoch in range(7):
+        ckpt.save_checkpoint(str(tmp_path), state, {"epoch": epoch}, epoch, max_models_to_save=3)
+    assert ckpt.available_epochs(str(tmp_path)) == [4, 5, 6]
+    assert ckpt.latest_checkpoint_exists(str(tmp_path))
+    # epoch-indexed load (reference load_model(model_idx=epoch))
+    restored, book = ckpt.load_checkpoint(str(tmp_path), 5, system.init_train_state())
+    assert book["epoch"] == 5

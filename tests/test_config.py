@@ -1,0 +1,71 @@
+"""Config schema tests: every reference config.yaml key exists, presets map
+like hydra node interpolation did, YAML + dotlist overrides compose."""
+
+import pytest
+import yaml
+
+from howtotrainyourmamlpytorch_tpu.config import Config, load_config, save_config
+
+# every key from the reference config.yaml (SURVEY.md §2.8)
+REFERENCE_KEYS = [
+    "num_dataprovider_workers", "max_models_to_save", "dataset",
+    "sets_are_pre_split", "load_from_npz_files", "load_into_memory",
+    "samples_per_iter", "num_target_samples", "num_of_gpus",
+    "num_classes_per_set", "num_samples_per_class", "batch_size",
+    "seed", "train_seed", "val_seed", "test_seed",
+    "learnable_inner_opt_params", "use_multi_step_loss_optimization",
+    "multi_step_loss_num_epochs", "minimum_per_task_contribution",
+    "num_evaluation_tasks", "total_epochs", "total_epochs_before_pause",
+    "total_iter_per_epoch", "continue_from_epoch", "second_order",
+    "first_order_to_second_order_epoch", "number_of_training_steps_per_iter",
+    "number_of_evaluation_steps_per_iter", "evaluate_on_test_set_only",
+    "meta_learning_rate", "min_learning_rate", "reverse_channels",
+    "labels_as_int", "reset_stored_filepaths", "net", "inner_optim",
+]
+
+
+def test_all_reference_keys_present():
+    cfg = Config()
+    for key in REFERENCE_KEYS:
+        assert hasattr(cfg, key), f"missing reference config key: {key}"
+
+
+def test_reference_defaults():
+    cfg = Config()
+    assert cfg.num_classes_per_set == 20 and cfg.num_samples_per_class == 5
+    assert cfg.batch_size == 8 and cfg.total_epochs == 150
+    assert cfg.total_iter_per_epoch == 500 and cfg.meta_learning_rate == 1e-3
+    assert cfg.inner_optim.kind == "sgd" and cfg.inner_optim.lr == 0.1
+    assert cfg.net == "vgg" and cfg.second_order
+
+
+def test_presets_and_overrides():
+    cfg = load_config(None, ["inner_optim=adam", "dataset=imagenet", "net=resnet-8"])
+    assert cfg.inner_optim.kind == "adam" and cfg.inner_optim.beta1 == 0.5
+    assert cfg.dataset.name == "mini_imagenet_full_size"
+    assert cfg.image_shape == (84, 84, 3) and cfg.is_imagenet
+
+
+def test_dotted_overrides():
+    cfg = load_config(None, ["inner_optim.lr=0.05", "parallel.dp=4", "batch_size=16"])
+    assert cfg.inner_optim.lr == 0.05
+    assert cfg.parallel.dp == 4 and cfg.batch_size == 16
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(KeyError):
+        load_config(None, ["no_such_key=1"])
+
+
+def test_yaml_roundtrip(tmp_path):
+    cfg = load_config(None, ["net=densenet-8", "seed=3"])
+    path = tmp_path / "config.yaml"
+    save_config(cfg, str(path))
+    cfg2 = load_config(str(path), [])
+    assert cfg2.net == "densenet-8" and cfg2.seed == 3
+    assert cfg2.to_dict() == cfg.to_dict()
+
+
+def test_run_name_matches_reference_scheme():
+    cfg = Config()
+    assert cfg.run_name() == "omniglot_dataset.20.5"

@@ -1,0 +1,196 @@
+"""Data pipeline tests (SURVEY.md §4): index bootstrap + JSON cache format,
+class-level splits, episode sampler determinism and RNG-sequence parity with
+the reference's RandomState discipline, label remap, loader resume."""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from howtotrainyourmamlpytorch_tpu.config import Config, DatasetConfig
+from howtotrainyourmamlpytorch_tpu.data import FewShotDataset, MetaLearningDataLoader
+from howtotrainyourmamlpytorch_tpu.data.index import (
+    build_index,
+    check_dataset_integrity,
+    load_or_build_index,
+)
+
+
+@pytest.fixture(scope="module")
+def toy_dataset(tmp_path_factory):
+    """A miniature omniglot-like tree: alphabet/character/img.png with the
+    class identified by the last two directory levels."""
+    root = tmp_path_factory.mktemp("data") / "omniglot_toy"
+    rng = np.random.RandomState(0)
+    n_alphabets, chars_per, imgs_per = 4, 5, 8  # 20 classes
+    for a in range(n_alphabets):
+        for c in range(chars_per):
+            d = root / f"alphabet{a}" / f"char{c}"
+            d.mkdir(parents=True)
+            for i in range(imgs_per):
+                arr = (rng.rand(28, 28) > 0.5).astype(np.uint8) * 255
+                Image.fromarray(arr, mode="L").convert("1").save(d / f"{i}.png")
+    return str(root)
+
+
+def toy_config(toy_dataset, **overrides):
+    base = dict(
+        dataset=DatasetConfig(name="omniglot_toy", path=toy_dataset),
+        num_classes_per_set=3,
+        num_samples_per_class=2,
+        num_target_samples=2,
+        batch_size=2,
+        load_into_memory=True,
+        num_dataprovider_workers=2,
+        # 20 toy classes: the omniglot ratios would leave the val split empty,
+        # so widen it (the override knob itself is under test here too)
+        train_val_test_split=(0.6, 0.2, 0.2),
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def test_index_bootstrap_and_cache_format(toy_dataset):
+    paths, idx_to_label, label_to_idx = load_or_build_index(toy_dataset, "omniglot_toy")
+    assert len(paths) == 20
+    assert all(len(v) == 8 for v in paths.values())
+    # cache format parity: {dataset}.json next to the dataset dir, class-idx keys
+    cache = os.path.join(os.path.split(toy_dataset)[0], "omniglot_toy.json")
+    assert os.path.exists(cache)
+    with open(cache) as f:
+        on_disk = json.load(f)
+    assert set(on_disk.keys()) == {str(i) for i in range(20)}
+    # label format: "<grandparent>/<parent>"
+    assert idx_to_label["0"].count("/") == 1
+    # second call loads the cache (and key types match the JSON round-trip)
+    paths2, _, _ = load_or_build_index(toy_dataset, "omniglot_toy")
+    assert paths2 == paths
+
+
+def test_class_level_split_ratios(toy_dataset):
+    ds = FewShotDataset(toy_config(toy_dataset))  # (0.6, 0.2, 0.2) over 20
+    sizes = {k: len(v) for k, v in ds.datasets.items()}
+    assert sizes == {"train": 12, "val": 4, "test": 4}
+    # split is over *classes*: no class appears in two splits
+    all_keys = [k for split in ds.datasets.values() for k in split]
+    assert len(all_keys) == len(set(all_keys))
+
+
+def test_default_spec_ratios_apply_without_override(toy_dataset):
+    # omniglot ratios ~ [0.709, 0.031, 0.261] over 20 classes -> train=14
+    ds = FewShotDataset(toy_config(toy_dataset, train_val_test_split=()))
+    assert len(ds.datasets["train"]) == 14
+    assert sum(len(v) for v in ds.datasets.values()) == 20
+
+
+def test_split_is_deterministic_in_val_seed(toy_dataset):
+    a = FewShotDataset(toy_config(toy_dataset, val_seed=0))
+    b = FewShotDataset(toy_config(toy_dataset, val_seed=0))
+    c = FewShotDataset(toy_config(toy_dataset, val_seed=7))
+    assert list(a.datasets["train"]) == list(b.datasets["train"])
+    assert list(a.datasets["train"]) != list(c.datasets["train"])
+
+
+def test_episode_determinism_and_shapes(toy_dataset):
+    ds = FewShotDataset(toy_config(toy_dataset))
+    e1 = ds.sample_episode("train", seed=1234, augment=True)
+    e2 = ds.sample_episode("train", seed=1234, augment=True)
+    e3 = ds.sample_episode("train", seed=1235, augment=True)
+    assert e1["x_support"].shape == (3, 2, 28, 28, 1)
+    assert e1["x_target"].shape == (3, 2, 28, 28, 1)
+    np.testing.assert_array_equal(e1["x_support"], e2["x_support"])
+    assert not np.array_equal(e1["x_support"], e3["x_support"])
+    # labels are episode-local 0..n_way-1 (reference data.py:499-501)
+    np.testing.assert_array_equal(e1["y_support"][:, 0], [0, 1, 2])
+
+
+def test_episode_rng_call_sequence_matches_reference(toy_dataset):
+    """Replicate the exact RandomState call sequence of reference get_set
+    (data.py:493-508) and check the sampler selected the same classes/samples."""
+    ds = FewShotDataset(toy_config(toy_dataset))
+    seed = 4242
+    counts = ds.class_counts["train"]
+    rng = np.random.RandomState(seed)
+    selected = rng.choice(list(counts.keys()), size=3, replace=False)
+    rng.shuffle(selected)
+    k_list = rng.randint(0, 4, size=3)
+    expected = []
+    for class_key in selected:
+        idx = rng.choice(counts[class_key], size=4, replace=False)
+        imgs = np.stack([ds.datasets["train"][class_key][i] for i in idx])
+        k = int(k_list[list(selected).index(class_key)])
+        expected.append(np.stack([np.rot90(im, k=k, axes=(0, 1)) for im in imgs]))
+    episode = ds.sample_episode("train", seed=seed, augment=True)
+    got = np.concatenate([episode["x_support"], episode["x_target"]], axis=1)
+    np.testing.assert_array_equal(got, np.stack(expected))
+
+
+def test_eval_episodes_not_rotated(toy_dataset):
+    """Omniglot rotation augmentation applies to train episodes only
+    (reference data.py:90-93)."""
+    ds = FewShotDataset(toy_config(toy_dataset))
+    plain = ds.sample_episode("val", seed=99, augment=False)
+    aug = ds.sample_episode("val", seed=99, augment=True)
+    # same seed, augment toggles rotation; with k=0 classes they can match,
+    # so check at least the shapes & that augment=False is pure re-load
+    again = ds.sample_episode("val", seed=99, augment=False)
+    np.testing.assert_array_equal(plain["x_support"], again["x_support"])
+    assert plain["x_support"].shape == aug["x_support"].shape
+
+
+def test_test_stream_seeded_from_val_seed_quirk(toy_dataset):
+    """Reference quirk (data.py:143-148): test episodes are a function of
+    val_seed. Preserved by default; disabled via config flag."""
+    ds = FewShotDataset(toy_config(toy_dataset, val_seed=3, test_seed=5))
+    assert ds.init_seed["test"] == ds.init_seed["val"]
+    ds2 = FewShotDataset(
+        toy_config(toy_dataset, val_seed=3, test_seed=5, test_stream_uses_val_seed=False)
+    )
+    assert ds2.init_seed["test"] != ds2.init_seed["val"]
+
+
+def test_loader_batches_and_resume(toy_dataset):
+    cfg = toy_config(toy_dataset)
+    loader = MetaLearningDataLoader(cfg)
+    batches = list(loader.train_batches(3))
+    assert len(batches) == 3
+    assert batches[0]["x_support"].shape == (2, 3, 2, 28, 28, 1)
+    assert loader.train_episodes_produced == 6
+    # resume from iteration 1 reproduces batches 1..2 exactly
+    loader2 = MetaLearningDataLoader(cfg, dataset=loader.dataset, current_iter=1)
+    resumed = list(loader2.train_batches(2))
+    np.testing.assert_array_equal(resumed[0]["x_support"], batches[1]["x_support"])
+    np.testing.assert_array_equal(resumed[1]["y_target"], batches[2]["y_target"])
+
+
+def test_val_stream_identical_every_epoch(toy_dataset):
+    cfg = toy_config(toy_dataset)
+    loader = MetaLearningDataLoader(cfg)
+    a = list(loader.val_batches(2))
+    b = list(loader.val_batches(2))
+    np.testing.assert_array_equal(a[0]["x_support"], b[0]["x_support"])
+    np.testing.assert_array_equal(a[1]["x_support"], b[1]["x_support"])
+
+
+def test_integrity_check_fails_fast(tmp_path):
+    """The reference deletes the dataset dir and recurses on a bad count
+    (utils/dataset_tools.py:42-44) — we must fail fast instead."""
+    d = tmp_path / "omniglot_dataset"
+    d.mkdir()
+    Image.fromarray(np.zeros((5, 5), np.uint8)).save(d / "img.png")
+    with pytest.raises(RuntimeError, match="expected"):
+        check_dataset_integrity(str(d), "omniglot_dataset")
+    assert d.exists()  # and must NOT delete the data
+
+
+def test_build_index_drops_unreadable_images(tmp_path):
+    d = tmp_path / "ds"
+    (d / "a" / "b").mkdir(parents=True)
+    Image.fromarray(np.zeros((5, 5), np.uint8)).save(d / "a" / "b" / "good.png")
+    (d / "a" / "b" / "bad.png").write_bytes(b"not a png")
+    with pytest.warns(UserWarning, match="unreadable"):
+        paths, _, _ = build_index(str(d))
+    assert sum(len(v) for v in paths.values()) == 1

@@ -1,0 +1,247 @@
+"""Meta-step program tests (SURVEY.md §4 'Gradient' tier): second-order
+meta-gradient vs finite differences, first-order/second-order divergence (the
+knob the reference silently broke), MSL weighting, cosine schedule parity with
+torch, warm-start semantics, and a learning smoke test."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from howtotrainyourmamlpytorch_tpu.config import Config, InnerOptimConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem, cosine_epoch_schedule
+from howtotrainyourmamlpytorch_tpu.data.synthetic import (
+    learnable_synthetic_batch,
+    synthetic_batch,
+)
+from howtotrainyourmamlpytorch_tpu.models import Model, build_vgg
+
+TINY_SHAPE = (8, 8, 1)
+
+
+def tiny_linear_model(num_classes=3, dim=None):
+    """Minimal pure-linear model for gradient math tests."""
+    d = dim or int(np.prod(TINY_SHAPE))
+
+    def init(key):
+        k1, k2 = jax.random.split(key)
+        params = {
+            "w": 0.1 * jax.random.normal(k1, (d, num_classes)),
+            "b": jnp.zeros((num_classes,)),
+        }
+        return params, {}
+
+    def apply(params, state, x, *, use_batch_stats=True, update_running=False):
+        flat = x.reshape((x.shape[0], -1))
+        return flat @ params["w"] + params["b"], state
+
+    return Model(init=init, apply=apply, name="tiny")
+
+
+def tiny_config(**overrides) -> Config:
+    base = dict(
+        num_classes_per_set=3,
+        num_samples_per_class=2,
+        num_target_samples=2,
+        batch_size=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        total_iter_per_epoch=4,
+        total_epochs=5,
+        multi_step_loss_num_epochs=3,
+        seed=0,
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def tiny_batch(seed=0, n_way=3, k=2, t=2):
+    return synthetic_batch(2, n_way, k, t, TINY_SHAPE, seed=seed)
+
+
+def _as_jnp(batch):
+    return {k: jnp.asarray(v) for k, v in batch.items()}
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_cosine_schedule_matches_torch():
+    meta_lr, min_lr, total_epochs, iters = 1e-3, 1e-5, 150, 500
+    sched = cosine_epoch_schedule(meta_lr, min_lr, total_epochs, iters)
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.Adam([p], lr=meta_lr)
+    scheduler = torch.optim.lr_scheduler.CosineAnnealingLR(opt, T_max=total_epochs, eta_min=min_lr)
+    for epoch in [0, 1, 5, 75, 149]:
+        scheduler.step(epoch=epoch)
+        torch_lr = opt.param_groups[0]["lr"]
+        ours = float(sched(epoch * iters + 3))  # any iter within the epoch
+        np.testing.assert_allclose(ours, torch_lr, rtol=1e-4)  # f32 cosine
+
+
+def test_second_order_meta_gradient_vs_finite_differences():
+    cfg = tiny_config(use_multi_step_loss_optimization=False, learnable_inner_opt_params=False)
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    batch = _as_jnp(tiny_batch())
+
+    def objective(params):
+        loss, _ = system._meta_objective(
+            {"params": params, "hparams": {}},
+            state.bn_state,
+            None,
+            batch,
+            jnp.asarray(0),
+            True,
+            cfg.number_of_training_steps_per_iter,
+            False,  # msl_active
+        )
+        return loss
+
+    g = jax.grad(objective)(state.params)
+    eps = 1e-3
+    rng = np.random.RandomState(0)
+    for name in ["w", "b"]:
+        arr = np.asarray(state.params[name])
+        for _ in range(3):
+            idx = tuple(rng.randint(0, s) for s in arr.shape)
+            # NB: jnp.asarray can alias numpy memory on CPU — copy per probe.
+            plus = arr.copy()
+            plus[idx] += eps
+            minus = arr.copy()
+            minus[idx] -= eps
+            p_plus = dict(state.params, **{name: jnp.asarray(plus)})
+            p_minus = dict(state.params, **{name: jnp.asarray(minus)})
+            fd = (float(objective(p_plus)) - float(objective(p_minus))) / (2 * eps)
+            np.testing.assert_allclose(
+                float(np.asarray(g[name])[idx]), fd, rtol=2e-2, atol=1e-4
+            )
+
+
+def test_first_vs_second_order_differ():
+    """The reference broke first-order (SURVEY.md §2.2); here it must be a real
+    switch: the two gradients should differ."""
+    cfg = tiny_config(use_multi_step_loss_optimization=False, learnable_inner_opt_params=False)
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    batch = _as_jnp(tiny_batch())
+
+    def objective(params, second_order):
+        loss, _ = system._meta_objective(
+            {"params": params, "hparams": {}},
+            state.bn_state,
+            None,
+            batch,
+            jnp.asarray(0),
+            second_order,
+            cfg.number_of_training_steps_per_iter,
+            False,  # msl_active
+        )
+        return loss
+
+    g2 = jax.grad(lambda p: objective(p, True))(state.params)
+    g1 = jax.grad(lambda p: objective(p, False))(state.params)
+    diff = float(
+        jnp.linalg.norm(g2["w"] - g1["w"]) / (jnp.linalg.norm(g2["w"]) + 1e-12)
+    )
+    assert diff > 1e-3, f"first- and second-order gradients identical (diff={diff})"
+
+
+def test_msl_weighting_matches_manual_rollout():
+    """Meta-loss must equal sum_i w_i * CE(target after step i), mean over tasks."""
+    cfg = tiny_config(learnable_inner_opt_params=False)
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    batch = _as_jnp(tiny_batch())
+    epoch = 1
+    loss, aux = system._meta_objective(
+        {"params": state.params, "hparams": {}},
+        state.bn_state,
+        None,
+        batch,
+        jnp.asarray(epoch),
+        True,
+        cfg.number_of_training_steps_per_iter,
+        True,  # msl_active: epoch 1 < multi_step_loss_num_epochs
+    )
+
+    # manual per-task rollout with plain SGD
+    from howtotrainyourmamlpytorch_tpu.ops.losses import cross_entropy
+    from howtotrainyourmamlpytorch_tpu.ops.msl import per_step_loss_importance
+
+    w_vec = np.asarray(
+        per_step_loss_importance(epoch, cfg.number_of_training_steps_per_iter, cfg.multi_step_loss_num_epochs)
+    )
+    model = system.model
+    total = []
+    for b in range(2):
+        p = state.params
+        xs = batch["x_support"][b].reshape((-1,) + TINY_SHAPE)
+        ys = batch["y_support"][b].reshape(-1)
+        xt = batch["x_target"][b].reshape((-1,) + TINY_SHAPE)
+        yt = batch["y_target"][b].reshape(-1)
+        task_loss = 0.0
+        for i in range(cfg.number_of_training_steps_per_iter):
+            grads = jax.grad(lambda q: cross_entropy(model.apply(q, {}, xs)[0], ys))(p)
+            p = jax.tree.map(lambda a, g: a - cfg.inner_optim.lr * g, p, grads)
+            task_loss += w_vec[i] * float(cross_entropy(model.apply(p, {}, xt)[0], yt))
+        total.append(task_loss)
+    np.testing.assert_allclose(float(loss), np.mean(total), rtol=1e-5)
+
+
+def test_warm_start_seeds_inner_adam_from_outer_state():
+    cfg = tiny_config(inner_optim=InnerOptimConfig(kind="adam", lr=0.1, beta1=0.5, beta2=0.5))
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    # Run one train step so the outer Adam accumulates moments.
+    state2, _ = system.train_step(state, _as_jnp(tiny_batch()))
+    hp = system._inner_hparams_for_rollout(state2.inner_hparams, state2.params)
+    inner0 = system._initial_inner_state(state2.params, hp, state2.opt_state)
+    assert float(jnp.abs(inner0["exp_avg"]["w"]).sum()) > 0  # warm-started
+    assert float(inner0["step"]["w"]) == 1.0
+    cfg_cold = dataclasses.replace(cfg, warm_start_inner_opt_from_outer=False)
+    system_cold = MAMLSystem(cfg_cold, model=tiny_linear_model())
+    inner0_cold = system_cold._initial_inner_state(state2.params, hp, state2.opt_state)
+    assert float(jnp.abs(inner0_cold["exp_avg"]["w"]).sum()) == 0.0
+
+
+def test_train_step_learns_synthetic_tasks():
+    # long cosine horizon + larger meta-lr so 40 steps of signal are visible
+    cfg = tiny_config(total_epochs=100, total_iter_per_epoch=50, meta_learning_rate=0.01)
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    losses = []
+    for i in range(40):
+        batch = _as_jnp(learnable_synthetic_batch(2, 3, 2, 2, TINY_SHAPE, seed=i % 4))
+        state, out = system.train_step(state, batch)
+        losses.append(float(out.loss))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.9, losses
+    eval_out = system.eval_step(state, _as_jnp(learnable_synthetic_batch(2, 3, 2, 2, TINY_SHAPE, seed=2)))
+    assert float(eval_out.accuracy) > 0.5
+
+
+def test_learned_lrs_change_and_stay_projected():
+    cfg = tiny_config()
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    lr0 = np.asarray(state.inner_hparams["lr"]["w"])
+    for i in range(5):
+        state, _ = system.train_step(state, _as_jnp(learnable_synthetic_batch(2, 3, 2, 2, TINY_SHAPE, seed=i)))
+    lr1 = np.asarray(state.inner_hparams["lr"]["w"])
+    assert lr1 != lr0
+    assert lr1 >= 1e-4 - 1e-8
+
+
+def test_vgg_meta_step_runs():
+    """End-to-end meta-step through a real conv+BN backbone (small variant)."""
+    cfg = tiny_config(num_classes_per_set=3)
+    model = build_vgg((8, 8, 1), 3, num_stages=2, cnn_num_filters=8)
+    system = MAMLSystem(cfg, model=model)
+    state = system.init_train_state()
+    state, out = system.train_step(state, _as_jnp(tiny_batch()))
+    assert np.isfinite(float(out.loss))
+    assert 0.0 <= float(out.accuracy) <= 1.0
+    assert int(state.step) == 1

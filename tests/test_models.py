@@ -1,0 +1,127 @@
+"""Model-core tests: shapes for every backbone/dataset combo, BN semantics,
+and torch-parity of the layer primitives (conv / BN / pooling math checked
+against torch.nn.functional as an independent oracle)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from howtotrainyourmamlpytorch_tpu.models import build_model, layers
+from howtotrainyourmamlpytorch_tpu.models.registry import MODEL_NAMES
+
+OMNIGLOT = (28, 28, 1)
+IMAGENET = (84, 84, 3)
+
+
+# Full backbone family on omniglot; one net per family on imagenet shapes
+# (the imagenet variants differ only in input dims — keep the 1-core CI fast).
+_COMBOS = [(net, OMNIGLOT) for net in MODEL_NAMES] + [
+    ("vgg", IMAGENET),
+    ("resnet-4", IMAGENET),
+    ("densenet-8", IMAGENET),
+]
+
+
+@pytest.mark.parametrize("net,image_shape", _COMBOS)
+def test_forward_shapes(net, image_shape):
+    n_way = 5
+    model = build_model(net, image_shape, n_way)
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = jnp.ones((2,) + image_shape)
+    logits, new_state = model.apply(params, state, x)
+    assert logits.shape == (2, n_way)
+    assert jnp.all(jnp.isfinite(logits))
+    assert jax.tree.structure(new_state) == jax.tree.structure(state)
+
+
+def test_vgg_feature_width_matches_reference():
+    """Reference VGG flatten width: 64 feats on omniglot (28->14->7->3->1),
+    64*5*5 on imagenet (84->42->21->10->5) — models.py:46-48 dummy-inference."""
+    m_o = build_model("vgg", OMNIGLOT, 5)
+    p_o, _ = m_o.init(jax.random.PRNGKey(0))
+    assert p_o["fc"]["w"].shape == (64, 5)
+    m_i = build_model("vgg", IMAGENET, 5)
+    p_i, _ = m_i.init(jax.random.PRNGKey(0))
+    assert p_i["fc"]["w"].shape == (64 * 5 * 5, 5)
+
+
+def test_densenet_feature_progression():
+    """Stem-less DenseNet-BC feature count (reference models.py:180-199):
+    omniglot densenet-8: 1 ->(block)17 ->(trans)8 ->24 ->12 ->28 ->14 ->30."""
+    m = build_model("densenet-8", OMNIGLOT, 5)
+    p, _ = m.init(jax.random.PRNGKey(0))
+    assert p["classifier"]["w"].shape[0] == 30
+    assert p["norm5"]["scale"].shape == (30,)
+
+
+def test_conv_matches_torch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 9, 9, 3).astype(np.float32)
+    w = rng.randn(3, 3, 3, 8).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    ours = layers.conv2d({"w": jnp.array(w), "b": jnp.array(b)}, jnp.array(x), stride=2, padding=1)
+    theirs = F.conv2d(
+        torch.tensor(x).permute(0, 3, 1, 2),
+        torch.tensor(w).permute(3, 2, 0, 1),
+        torch.tensor(b),
+        stride=2,
+        padding=1,
+    ).permute(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_batch_norm_matches_torch_train_mode():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 5, 5, 7).astype(np.float32)
+    scale = rng.rand(7).astype(np.float32) + 0.5
+    bias = rng.randn(7).astype(np.float32)
+    params = {"scale": jnp.array(scale), "bias": jnp.array(bias)}
+    _, state = layers.init_batch_norm(7)
+    ours, new_state = layers.batch_norm(params, state, jnp.array(x), update_running=True)
+    xt = torch.tensor(x).permute(0, 3, 1, 2)
+    bn = torch.nn.BatchNorm2d(7)
+    bn.weight.data = torch.tensor(scale)
+    bn.bias.data = torch.tensor(bias)
+    bn.train()
+    theirs = bn(xt).permute(0, 2, 3, 1).detach().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(new_state["mean"]), bn.running_mean.numpy(), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(new_state["var"]), bn.running_var.numpy(), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_max_pool_matches_torch_floor_mode():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 7, 7, 2).astype(np.float32)  # odd size -> floor matters
+    ours = layers.max_pool(jnp.array(x))
+    theirs = (
+        F.max_pool2d(torch.tensor(x).permute(0, 3, 1, 2), 2, 2).permute(0, 2, 3, 1).numpy()
+    )
+    assert ours.shape == theirs.shape == (1, 3, 3, 2)
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-5, atol=1e-6)
+
+
+def test_transductive_bn_is_default():
+    """Normalization must use batch stats even with stale running stats
+    (reference evaluates in train mode — few_shot_learning_system.py:388)."""
+    params = {"scale": jnp.ones((3,)), "bias": jnp.zeros((3,))}
+    state = {"mean": jnp.full((3,), 100.0), "var": jnp.full((3,), 0.01), "count": jnp.zeros(())}
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 4, 4, 3))
+    out, _ = layers.batch_norm(params, state, x)
+    assert abs(float(jnp.mean(out))) < 1e-4  # normalized by batch stats, not running
+
+
+def test_init_distributions():
+    """torch-default conv init: U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+    w = layers.kaiming_uniform_conv(jax.random.PRNGKey(0), (3, 3, 64, 64))
+    bound = 1.0 / np.sqrt(3 * 3 * 64)
+    assert float(jnp.max(jnp.abs(w))) <= bound + 1e-6
+    w2 = layers.kaiming_normal_conv(jax.random.PRNGKey(1), (3, 3, 64, 128), mode="fan_out")
+    expected_std = np.sqrt(2.0 / (128 * 9))
+    assert abs(float(jnp.std(w2)) - expected_std) / expected_std < 0.05

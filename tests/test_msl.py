@@ -1,0 +1,38 @@
+"""MSL weight schedule vs an independent re-implementation of the reference
+loop (few_shot_learning_system.py:131-151)."""
+
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.ops.msl import final_step_only, per_step_loss_importance
+
+
+def _reference_loop(epoch, n_steps, msl_epochs):
+    loss_weights = np.ones(n_steps) * (1.0 / n_steps)
+    decay_rate = 1.0 / n_steps / msl_epochs
+    min_non_final = 0.03 / n_steps
+    for i in range(len(loss_weights) - 1):
+        loss_weights[i] = np.maximum(loss_weights[i] - epoch * decay_rate, min_non_final)
+    loss_weights[-1] = np.minimum(
+        loss_weights[-1] + epoch * (n_steps - 1) * decay_rate,
+        1.0 - (n_steps - 1) * min_non_final,
+    )
+    return loss_weights
+
+
+def test_matches_reference_schedule():
+    for n_steps, msl_epochs in [(5, 10), (3, 10), (5, 4), (10, 2)]:
+        for epoch in range(0, 25):
+            ours = np.asarray(per_step_loss_importance(epoch, n_steps, msl_epochs))
+            ref = _reference_loop(epoch, n_steps, msl_epochs)
+            np.testing.assert_allclose(ours, ref, rtol=1e-6, atol=1e-7, err_msg=f"epoch={epoch}")
+
+
+def test_weights_sum_to_one_before_saturation():
+    for epoch in range(10):
+        w = np.asarray(per_step_loss_importance(epoch, 5, 10))
+        np.testing.assert_allclose(w.sum(), 1.0, rtol=1e-6)
+
+
+def test_final_step_only():
+    w = np.asarray(final_step_only(5))
+    assert w[-1] == 1.0 and w[:-1].sum() == 0.0

@@ -1,0 +1,85 @@
+"""SPMD tests on the 8-device virtual CPU mesh (SURVEY.md §4 'distributed
+without a cluster'): mesh construction, sharded meta-step numerical parity
+with the single-device step, and the explicit shard_map psum path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from howtotrainyourmamlpytorch_tpu.config import ParallelConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    batch_sharding,
+    make_mesh,
+    replicate,
+    shard_batch,
+)
+
+from tests.test_maml_core import TINY_SHAPE, _as_jnp, tiny_config, tiny_linear_model
+
+
+def test_virtual_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh(ParallelConfig(dp=-1, mp=1))
+    assert mesh.shape == {"dp": 8, "mp": 1}
+    mesh2 = make_mesh(ParallelConfig(dp=4, mp=2))
+    assert mesh2.shape == {"dp": 4, "mp": 2}
+    with pytest.raises(ValueError):
+        make_mesh(ParallelConfig(dp=16, mp=1))
+
+
+def test_sharded_train_step_matches_single_device():
+    """The whole point of the pjit design: sharding the meta-batch over dp must
+    not change the numbers (XLA inserts the psum mean of meta-grads)."""
+    cfg = tiny_config(batch_size=8)
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    batch = _as_jnp(synthetic_batch(8, 3, 2, 2, TINY_SHAPE, seed=5))
+
+    state_a = system.init_train_state()
+    state_a, out_a = system.train_step(state_a, batch)
+
+    mesh = make_mesh(ParallelConfig(dp=8))
+    state_b = replicate(system.init_train_state(), mesh)
+    sharded = shard_batch(batch, mesh)
+    assert sharded["x_support"].sharding.spec == P("dp")
+    state_b, out_b = system.train_step(state_b, sharded)
+
+    np.testing.assert_allclose(float(out_a.loss), float(out_b.loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(state_a.params["w"]), np.asarray(state_b.params["w"]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_explicit_shard_map_psum_meta_grad():
+    """Unit test of the meta-grad collective (SURVEY.md §4). Under JAX's VMA
+    typing, ``jax.grad`` w.r.t. a *replicated* arg inside ``shard_map``
+    already inserts the cross-device psum (the transpose of the
+    replicated->varying broadcast), so the per-shard loss is scaled by 1/dp to
+    make that psum compute the global-batch *mean* gradient."""
+    mesh = make_mesh(ParallelConfig(dp=8))
+    dp = mesh.shape["dp"]
+    xs = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4) / 10.0
+    w = jnp.ones((4,))
+
+    def loss(w, x):
+        return jnp.mean((x @ w) ** 2)
+
+    def per_shard(w, x):
+        return jax.grad(lambda w: loss(w, x) / dp)(w)
+
+    g_sharded = jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(P(), P("dp")),
+            out_specs=P(),
+        )
+    )(w, xs)
+    g_global = jax.grad(loss)(w, xs)
+    np.testing.assert_allclose(np.asarray(g_sharded), np.asarray(g_global), rtol=1e-5)

@@ -1,0 +1,101 @@
+"""Integration: a miniature end-to-end experiment through the runner —
+artifact contract (summary_statistics.csv columns, lrs.csv, test_summary.csv,
+JSON log), resume-from-latest, best-model selection."""
+
+import csv
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from howtotrainyourmamlpytorch_tpu.config import Config, DatasetConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
+from howtotrainyourmamlpytorch_tpu.experiment.storage import load_statistics
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+
+
+@pytest.fixture(scope="module")
+def toy_dataset(tmp_path_factory):
+    root = tmp_path_factory.mktemp("data") / "omniglot_toy"
+    rng = np.random.RandomState(0)
+    for a in range(4):
+        for c in range(5):
+            d = root / f"alpha{a}" / f"char{c}"
+            d.mkdir(parents=True)
+            base = (rng.rand(28, 28) > 0.5).astype(np.uint8) * 255
+            for i in range(6):
+                noisy = base ^ (rng.rand(28, 28) > 0.95).astype(np.uint8) * 255
+                Image.fromarray(noisy, mode="L").convert("1").save(d / f"{i}.png")
+    return str(root)
+
+
+def runner_config(toy_dataset, tmp_path, **overrides):
+    base = dict(
+        dataset=DatasetConfig(name="omniglot_toy", path=toy_dataset),
+        num_classes_per_set=3,
+        num_samples_per_class=2,
+        num_target_samples=2,
+        batch_size=2,
+        total_epochs=2,
+        total_iter_per_epoch=3,
+        num_evaluation_tasks=4,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        experiment_root=str(tmp_path),
+        experiment_name="toy_run",
+        load_into_memory=True,
+        num_dataprovider_workers=2,
+        train_val_test_split=(0.6, 0.2, 0.2),  # 20 toy classes need a real val split
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def small_system(cfg):
+    return MAMLSystem(cfg, model=build_vgg((28, 28, 1), cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4))
+
+
+def test_end_to_end_artifacts_and_resume(toy_dataset, tmp_path):
+    cfg = runner_config(toy_dataset, tmp_path)
+    runner = ExperimentRunner(cfg, system=small_system(cfg))
+    result = runner.run_experiment()
+
+    run_dir = runner.run_dir
+    logs = os.path.join(run_dir, "logs")
+    # artifact contract (reference utils/storage.py + nbs expectations)
+    assert os.path.isdir(os.path.join(run_dir, "saved_models"))
+    assert os.path.isdir(os.path.join(run_dir, "visual_outputs"))
+    rows = load_statistics(logs)
+    assert len(rows) == 2
+    for col in ("epoch", "train_accuracy_mean", "val_accuracy_mean",
+                "train_loss_mean", "val_loss_mean", "learning_rate"):
+        assert col in rows[0], f"missing column {col}"
+    test_rows = load_statistics(logs, "test_summary.csv")
+    assert "test_accuracy_mean" in test_rows[0]
+    assert os.path.exists(os.path.join(run_dir, "config.yaml"))
+    assert os.path.exists(os.path.join(logs, "toy_run.json"))
+    # lrs.csv: one row per epoch, one column per parameter tensor
+    with open(os.path.join(run_dir, "lrs.csv")) as f:
+        lr_rows = list(csv.reader(f))
+    assert len(lr_rows) == 2
+    assert "test_accuracy_mean" in result
+
+    # resume: a new runner continues from epoch 2 without retraining
+    cfg2 = runner_config(toy_dataset, tmp_path, total_epochs=3)
+    runner2 = ExperimentRunner(cfg2, system=small_system(cfg2))
+    assert runner2.start_epoch == 2
+    assert runner2.loader.train_episodes_produced == 2 * 3 * 2  # epochs*iters*batch
+    runner2.run_experiment()
+    assert len(load_statistics(logs)) == 3  # one more epoch appended
+
+
+def test_evaluate_on_test_set_only(toy_dataset, tmp_path):
+    cfg = runner_config(toy_dataset, tmp_path, evaluate_on_test_set_only=True,
+                        experiment_name="toy_eval_only")
+    runner = ExperimentRunner(cfg, system=small_system(cfg))
+    stats = runner.run_experiment()
+    assert "test_accuracy_mean" in stats
+    # no training happened
+    assert not os.path.exists(os.path.join(runner.run_dir, "logs", "summary_statistics.csv"))

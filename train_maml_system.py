@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Training entry point (reference ``train_maml_system.py``).
+
+Usage:
+    python train_maml_system.py [--config configs/omniglot_20way_5shot.yaml] \
+        [key=value ...]
+
+Overrides use dotted paths, e.g.::
+
+    python train_maml_system.py net=resnet-4 inner_optim=adam \
+        num_classes_per_set=5 num_samples_per_class=1 dataset=omniglot
+
+Unlike the reference (hydra 0.x chdir + hard-coded ``torch.device('cuda')``,
+``train_maml_system.py:16,23``), this runs against whatever JAX platform is
+visible (TPU chip(s), or CPU with ``JAX_PLATFORMS=cpu``) and writes artifacts
+under ``exps/{dataset}.{n_way}.{k_shot}`` without changing directory.
+"""
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", default=None, help="YAML config file")
+    parser.add_argument("overrides", nargs="*", help="key=value config overrides")
+    args = parser.parse_args(argv)
+
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # Site hooks (e.g. a TPU-tunnel plugin) may override the platform
+        # selection after capturing the env; re-assert the user's choice.
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from howtotrainyourmamlpytorch_tpu.config import load_config
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
+
+    cfg = load_config(args.config, args.overrides)
+    runner = ExperimentRunner(cfg)
+    print(f"run dir: {runner.run_dir}")
+    print(f"n_params: {runner.system.num_params(runner.state)}")
+    result = runner.run_experiment()
+    print(result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
