@@ -187,8 +187,21 @@ def _coerce(value: str) -> Any:
 def _set_dotted(data: Dict[str, Any], dotted: str, value: Any) -> None:
     keys = dotted.split(".")
     node = data
-    for key in keys[:-1]:
-        node = node.setdefault(key, {})
+    for name in keys[:-1]:
+        child = node.setdefault(name, {})
+        if isinstance(child, str):
+            # the base value is a preset name (e.g. `inner_optim: gd` in YAML
+            # followed by a CLI `inner_optim.lr=0.05`): expand the preset to
+            # its dict form so the dotted override can land on top of it.
+            presets = {"dataset": DATASET_PRESETS, "inner_optim": INNER_OPTIM_PRESETS}.get(name)
+            if presets is None or child not in presets:
+                raise KeyError(
+                    f"cannot apply override {dotted!r}: {name!r} is the "
+                    f"non-mapping value {child!r}"
+                )
+            child = dataclasses.asdict(presets[child])
+            node[name] = child
+        node = child
     node[keys[-1]] = value
 
 

@@ -47,6 +47,11 @@ class MetaLearningDataLoader:
         self.num_workers = max(cfg.num_dataprovider_workers, 1)
         self.train_episodes_produced = 0
         self.continue_from_iter(current_iter)
+        # persistent episode-assembly pool: one per loader, not per batch —
+        # episode work is a cheap numpy gather, pool churn would dominate it
+        self._episode_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.num_workers
+        )
 
     def continue_from_iter(self, current_iter: int) -> None:
         self.train_episodes_produced = current_iter * self.batch_size
@@ -66,13 +71,12 @@ class MetaLearningDataLoader:
 
         def build(batch_idx: int) -> Dict[str, np.ndarray]:
             base = start_index + batch_idx * bs
-            with concurrent.futures.ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-                episodes = list(
-                    pool.map(
-                        lambda j: ds.sample_episode(split, ds.episode_seed(split, base + j), augment),
-                        range(bs),
-                    )
+            episodes = list(
+                self._episode_pool.map(
+                    lambda j: ds.sample_episode(split, ds.episode_seed(split, base + j), augment),
+                    range(bs),
                 )
+            )
             return _stack(episodes)
 
         window = 2  # batches in flight ahead of the consumer

@@ -93,6 +93,10 @@ def latest_checkpoint_exists(save_dir: str) -> bool:
     return os.path.exists(_path(save_dir, "latest"))
 
 
+def checkpoint_exists(save_dir: str, idx) -> bool:
+    return os.path.exists(_path(save_dir, idx))
+
+
 def available_epochs(save_dir: str):
     pattern = re.compile(rf"^{MODEL_NAME}_(\d+)$")
     if not os.path.isdir(save_dir):

@@ -58,10 +58,20 @@ class ExperimentRunner:
         self.start_epoch = 0
         self.best_val_accuracy = -1.0
         self.best_val_epoch = -1
-        if cfg.continue_from_epoch not in ("", "scratch", None) and ckpt.latest_checkpoint_exists(
-            self.saved_models_dir
-        ):
-            idx = cfg.continue_from_epoch
+        idx = cfg.continue_from_epoch
+        resumable = idx not in ("", "scratch", None)
+        if resumable and not ckpt.checkpoint_exists(self.saved_models_dir, idx):
+            # 'latest' missing = a fresh run, start from scratch (reference
+            # continue_from_epoch semantics); a *named* epoch missing is a
+            # user error — fail fast instead of silently training anew.
+            if idx != "latest":
+                raise FileNotFoundError(
+                    f"continue_from_epoch={idx!r} but no such checkpoint in "
+                    f"{self.saved_models_dir} (have epochs "
+                    f"{ckpt.available_epochs(self.saved_models_dir)})"
+                )
+            resumable = False
+        if resumable:
             self.state, bookkeeping = ckpt.load_checkpoint(
                 self.saved_models_dir, idx, self.state
             )

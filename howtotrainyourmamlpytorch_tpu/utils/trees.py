@@ -56,7 +56,7 @@ def named_leaves(tree, prefix=""):
     """
     if isinstance(tree, dict):
         for key in sorted(tree.keys()):
-            yield from named_leaves(tree[key], f"{prefix}{key}." if prefix or True else key)
+            yield from named_leaves(tree[key], f"{prefix}{key}.")
     elif isinstance(tree, (list, tuple)):
         for i, item in enumerate(tree):
             yield from named_leaves(item, f"{prefix}{i}.")

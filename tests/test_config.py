@@ -52,6 +52,16 @@ def test_dotted_overrides():
     assert cfg.parallel.dp == 4 and cfg.batch_size == 16
 
 
+def test_dotted_override_on_preset_string():
+    # `inner_optim: gd` in YAML (a preset string) + a CLI dotted override:
+    # the preset must expand so the override lands on top of it
+    cfg = load_config(None, ["inner_optim=adam", "inner_optim.lr=0.05"])
+    assert cfg.inner_optim.kind == "adam" and cfg.inner_optim.lr == 0.05
+    assert cfg.inner_optim.beta1 == 0.5
+    with pytest.raises(KeyError):
+        load_config(None, ["net=vgg", "net.depth=3"])  # non-preset scalar
+
+
 def test_unknown_key_rejected():
     with pytest.raises(KeyError):
         load_config(None, ["no_such_key=1"])

@@ -99,3 +99,9 @@ def test_evaluate_on_test_set_only(toy_dataset, tmp_path):
     assert "test_accuracy_mean" in stats
     # no training happened
     assert not os.path.exists(os.path.join(runner.run_dir, "logs", "summary_statistics.csv"))
+
+
+def test_missing_named_epoch_fails_fast(toy_dataset, tmp_path):
+    cfg = runner_config(toy_dataset, tmp_path, continue_from_epoch="7")
+    with pytest.raises(FileNotFoundError, match="continue_from_epoch"):
+        ExperimentRunner(cfg, system=small_system(cfg))
