@@ -143,6 +143,11 @@ class Config:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     compute_dtype: str = "float32"  # or "bfloat16" for MXU-friendly compute
     remat_inner_steps: bool = True  # jax.checkpoint per inner step (SURVEY §5.7)
+    # Fully unroll the inner-step lax.scan: removes scan sequencing overhead
+    # and lets XLA fuse across steps (~+10% meta-steps/s on v5e for the
+    # flagship config); costs compile time O(steps). Remat still applies
+    # per step, so memory stays O(1) in steps.
+    unroll_inner_steps: bool = True
     profile_dir: str = ""  # non-empty: write jax.profiler traces here
 
     # ------------------------------------------------------------------
@@ -189,12 +194,12 @@ def _set_dotted(data: Dict[str, Any], dotted: str, value: Any) -> None:
     node = data
     for name in keys[:-1]:
         child = node.setdefault(name, {})
-        if isinstance(child, str):
-            # the base value is a preset name (e.g. `inner_optim: gd` in YAML
-            # followed by a CLI `inner_optim.lr=0.05`): expand the preset to
-            # its dict form so the dotted override can land on top of it.
+        if not isinstance(child, dict):
+            # the base value may be a preset name (e.g. `inner_optim: gd` in
+            # YAML followed by a CLI `inner_optim.lr=0.05`): expand the preset
+            # to its dict form so the dotted override can land on top of it.
             presets = {"dataset": DATASET_PRESETS, "inner_optim": INNER_OPTIM_PRESETS}.get(name)
-            if presets is None or child not in presets:
+            if presets is None or not isinstance(child, str) or child not in presets:
                 raise KeyError(
                     f"cannot apply override {dotted!r}: {name!r} is the "
                     f"non-mapping value {child!r}"

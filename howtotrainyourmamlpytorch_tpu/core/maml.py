@@ -197,6 +197,8 @@ class MAMLSystem:
                 grads = jax.tree.map(lax.stop_gradient, grads)
             return self.inner_opt.update(grads, opt_s, p, hparams)
 
+        unroll = num_steps if self.cfg.unroll_inner_steps else 1
+
         if per_step_target:
 
             def step(carry, weight):
@@ -210,7 +212,7 @@ class MAMLSystem:
                 step = jax.checkpoint(step, prevent_cse=False)
             logits0 = jnp.zeros((x_target.shape[0], self.cfg.num_classes_per_set))
             (_, _, final_logits), weighted_losses = lax.scan(
-                step, (params, inner_state, logits0), loss_weights
+                step, (params, inner_state, logits0), loss_weights, unroll=unroll
             )
             return jnp.sum(weighted_losses), final_logits
 
@@ -220,7 +222,9 @@ class MAMLSystem:
 
         if self.cfg.remat_inner_steps:
             step = jax.checkpoint(step, prevent_cse=False)
-        (p_final, _), _ = lax.scan(step, (params, inner_state), None, length=num_steps)
+        (p_final, _), _ = lax.scan(
+            step, (params, inner_state), None, length=num_steps, unroll=unroll
+        )
         final_logits = forward(p_final, x_target)
         return cross_entropy(final_logits, y_target), final_logits
 

@@ -21,6 +21,7 @@ nearly-identical episode streams across epochs. We advance the cursor per
 """
 
 import concurrent.futures
+import weakref
 from typing import Dict, Iterator, Optional
 
 import numpy as np
@@ -48,10 +49,21 @@ class MetaLearningDataLoader:
         self.train_episodes_produced = 0
         self.continue_from_iter(current_iter)
         # persistent episode-assembly pool: one per loader, not per batch —
-        # episode work is a cheap numpy gather, pool churn would dominate it
+        # episode work is a cheap numpy gather, pool churn would dominate it.
+        # Sized for both in-flight prefetch builds (window=2) so overlapping
+        # builds don't halve per-build parallelism.
         self._episode_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=self.num_workers
+            max_workers=self.num_workers * self._PREFETCH_WINDOW
         )
+        self._finalizer = weakref.finalize(
+            self, self._episode_pool.shutdown, wait=False
+        )
+
+    _PREFETCH_WINDOW = 2  # batches in flight ahead of the consumer
+
+    def close(self) -> None:
+        """Shut down the episode-assembly pool (also runs via GC finalizer)."""
+        self._finalizer()
 
     def continue_from_iter(self, current_iter: int) -> None:
         self.train_episodes_produced = current_iter * self.batch_size
@@ -79,7 +91,7 @@ class MetaLearningDataLoader:
             )
             return _stack(episodes)
 
-        window = 2  # batches in flight ahead of the consumer
+        window = self._PREFETCH_WINDOW
         with concurrent.futures.ThreadPoolExecutor(max_workers=window) as ahead:
             futures = {
                 i: ahead.submit(build, i) for i in range(min(window, total_batches))

@@ -90,7 +90,7 @@ def load_checkpoint(
 
 
 def latest_checkpoint_exists(save_dir: str) -> bool:
-    return os.path.exists(_path(save_dir, "latest"))
+    return checkpoint_exists(save_dir, "latest")
 
 
 def checkpoint_exists(save_dir: str, idx) -> bool:
