@@ -34,10 +34,11 @@ def main():
     # Reference defaults (omniglot 20-way 5-shot, vgg, B=8, 5 inner steps) with
     # the TPU-native training recipe: mixed precision (bfloat16 compute for the
     # MXU / half the HBM traffic; float32 master params, outer updates, and
-    # losses) and the inner-step scan fully unrolled. Convergence under this
-    # recipe is covered by tests/test_real_omniglot.py and scripts/convergence
-    # runs; accuracy-parity configs default to float32.
-    cfg = Config(compute_dtype="bfloat16")
+    # losses), the inner-step scan fully unrolled, and the inner SGD step run
+    # as the fused Pallas LSLR kernel (ops/pallas_update.py; parity-tested
+    # against the plain path). Convergence under this recipe is validated on
+    # real Omniglot; accuracy-parity configs default to float32.
+    cfg = Config(compute_dtype="bfloat16", use_pallas_inner_update=True)
     system = MAMLSystem(cfg)
     state = system.init_train_state()
     batch = {

@@ -148,6 +148,11 @@ class Config:
     # flagship config); costs compile time O(steps). Remat still applies
     # per step, so memory stays O(1) in steps.
     unroll_inner_steps: bool = True
+    # Route the inner SGD step through the fused Pallas kernel
+    # (ops/pallas_update.py): one kernel over the packed param pytree per
+    # inner step instead of one elementwise op per leaf. Identical math
+    # (custom VJP; parity-tested). SGD/gd inner optimizer only.
+    use_pallas_inner_update: bool = False
     profile_dir: str = ""  # non-empty: write jax.profiler traces here
 
     # ------------------------------------------------------------------

@@ -77,6 +77,13 @@ class MAMLSystem:
         kwargs = {"lr": io.lr}
         if io.kind == "adam":
             kwargs.update(beta1=io.beta1, beta2=io.beta2)
+        if cfg.use_pallas_inner_update:
+            if io.kind not in ("sgd", "gd"):
+                raise ValueError(
+                    "use_pallas_inner_update only supports the sgd/gd inner "
+                    f"optimizer, got inner_optim.kind={io.kind!r}"
+                )
+            kwargs["fused"] = True
         self.inner_opt = build_inner_optimizer(io.kind, **kwargs)
         self.schedule = cosine_epoch_schedule(
             cfg.meta_learning_rate,

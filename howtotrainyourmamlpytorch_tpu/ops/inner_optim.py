@@ -50,7 +50,11 @@ class InnerOptimizer(NamedTuple):
 # ---------------------------------------------------------------------------
 
 
-def sgd(lr: float = 0.1) -> InnerOptimizer:
+def sgd(lr: float = 0.1, fused: bool = False) -> InnerOptimizer:
+    """``fused=True`` routes the per-tensor-lr step through the Pallas fused
+    kernel (one kernel over the packed pytree instead of one elementwise op
+    per leaf — ops/pallas_update.py); identical math, custom VJP."""
+
     def init_hparams(params):
         return {"lr": tree_scalars_like(params, lr)}
 
@@ -58,6 +62,10 @@ def sgd(lr: float = 0.1) -> InnerOptimizer:
         return ()
 
     def update(grads, state, params, hparams):
+        if fused:
+            from .pallas_update import fused_sgd_update
+
+            return fused_sgd_update(params, grads, hparams["lr"]), state
         new_params = jax.tree.map(lambda p, g, a: p - a * g, params, grads, hparams["lr"])
         return new_params, state
 

@@ -1,0 +1,195 @@
+"""Fused LSLR inner-update as a Pallas TPU kernel (the native-kernel proof
+point promised by SURVEY.md §2.11/§7 stage 5).
+
+The LSLR-generalized inner SGD step applies ``p <- p - lr_t * g`` with one
+*learned scalar lr per parameter tensor* (reference one-param-group-per-tensor
+trick, ``few_shot_learning_system.py:94-102``). Expressed over the pytree this
+is one tiny elementwise op per leaf per inner step — dozens of kernel
+dispatches of a few KB each, exactly the latency-bound regime the meta-step
+profile shows. Here the whole pytree is packed once into a single
+``[rows, 128]`` lane-aligned buffer (each leaf padded to full 128-lane rows)
+and the update runs as ONE Pallas kernel: params and grads stream through VMEM
+row-tiles while the per-row lr (gathered from the per-tensor lr vector by a
+static row map) rides along as a ``[rows, 1]`` column.
+
+Differentiability: the inner update must be differentiable w.r.t. params,
+grads, AND the lrs (that is the whole LSLR point — meta-gradients flow into
+the per-tensor lrs), including through the second-order rollout. The kernel
+therefore carries a ``jax.custom_vjp``:
+
+    forward:  out = p - lr * g
+    backward: dp = ct;  dg = -lr * ct;  dlr_row = -sum_row(ct * g)
+
+with the backward implemented as a second fused kernel; the per-row lr
+cotangents reduce back to per-tensor lr cotangents through the (differentiable)
+gather's transpose, i.e. a segment-sum handled by XLA outside the kernel.
+
+Off-TPU (the CPU test mesh) the same kernels run in Pallas interpret mode, so
+the suite exercises the identical code path everywhere.
+"""
+
+import functools
+from typing import Any, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail on builds without the TPU extension
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+LANE = 128  # TPU lane width: last dim of every tile
+ROW_TILE = 256  # rows per grid step (256*128*4B = 128 KiB per operand block)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu" or not _HAS_PLTPU
+
+
+class PackedLayout(NamedTuple):
+    """Static description of the pytree -> [rows, 128] packing."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    leaf_rows: Tuple[int, ...]  # 128-lane rows occupied by each leaf
+    row_map: np.ndarray  # [padded_rows] int32: row -> leaf index
+    rows: int  # unpadded total rows
+    padded_rows: int  # rows rounded up to ROW_TILE
+
+
+def build_layout(params) -> PackedLayout:
+    leaves, treedef = jax.tree.flatten(params)
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    leaf_rows = tuple(max(1, -(-l.size // LANE)) for l in leaves)
+    rows = sum(leaf_rows)
+    padded_rows = -(-rows // ROW_TILE) * ROW_TILE
+    row_map = np.zeros((padded_rows,), np.int32)
+    r = 0
+    for i, n in enumerate(leaf_rows):
+        row_map[r : r + n] = i
+        r += n
+    # padding rows keep leaf index 0; their lr values are read but the rows
+    # are sliced away on unpack, so the value is irrelevant.
+    return PackedLayout(treedef, shapes, leaf_rows, row_map, rows, padded_rows)
+
+
+def pack(tree, layout: PackedLayout) -> jnp.ndarray:
+    """Pytree -> [padded_rows, LANE] buffer (differentiable: pad + concat)."""
+    leaves = jax.tree.leaves(tree)
+    parts = []
+    for leaf, n_rows in zip(leaves, layout.leaf_rows):
+        flat = leaf.reshape(-1)
+        flat = jnp.pad(flat, (0, n_rows * LANE - flat.size))
+        parts.append(flat.reshape(n_rows, LANE))
+    buf = jnp.concatenate(parts, axis=0)
+    if layout.padded_rows != layout.rows:
+        buf = jnp.pad(buf, ((0, layout.padded_rows - layout.rows), (0, 0)))
+    return buf
+
+
+def unpack(buf: jnp.ndarray, layout: PackedLayout):
+    """[padded_rows, LANE] buffer -> pytree (differentiable: slice + reshape)."""
+    leaves = []
+    r = 0
+    for shape, n_rows in zip(layout.shapes, layout.leaf_rows):
+        size = int(np.prod(shape)) if shape else 1
+        chunk = buf[r : r + n_rows].reshape(-1)[:size].reshape(shape)
+        leaves.append(chunk)
+        r += n_rows
+    return jax.tree.unflatten(layout.treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(p_ref, g_ref, lr_ref, out_ref):
+    out_ref[:] = p_ref[:] - lr_ref[:] * g_ref[:]
+
+
+def _bwd_kernel(ct_ref, g_ref, lr_ref, dg_ref, dlr_ref):
+    ct = ct_ref[:]
+    dg_ref[:] = -lr_ref[:] * ct
+    dlr_ref[:] = -jnp.sum(ct * g_ref[:], axis=1, keepdims=True)
+
+
+def _row_specs(n: int):
+    """n row-tiled [ROW_TILE, LANE] VMEM operands + one [ROW_TILE, 1] lr."""
+    kwargs = {"memory_space": pltpu.VMEM} if _HAS_PLTPU and not _interpret() else {}
+    wide = pl.BlockSpec((ROW_TILE, LANE), lambda i: (i, 0), **kwargs)
+    narrow = pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0), **kwargs)
+    return [wide] * n + [narrow]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _fused_sgd(p_buf, g_buf, lr_rows):
+    return _fused_sgd_fwd_impl(p_buf, g_buf, lr_rows)
+
+
+def _fused_sgd_fwd_impl(p_buf, g_buf, lr_rows):
+    grid = (p_buf.shape[0] // ROW_TILE,)
+    specs = _row_specs(2)
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=specs,
+        out_specs=pl.BlockSpec((ROW_TILE, LANE), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(p_buf.shape, p_buf.dtype),
+        interpret=_interpret(),
+    )(p_buf, g_buf, lr_rows)
+
+
+def _fused_sgd_fwd(p_buf, g_buf, lr_rows):
+    return _fused_sgd_fwd_impl(p_buf, g_buf, lr_rows), (g_buf, lr_rows)
+
+
+def _fused_sgd_bwd(residuals, ct):
+    g_buf, lr_rows = residuals
+    grid = (g_buf.shape[0] // ROW_TILE,)
+    specs = _row_specs(2)
+    dg, dlr_rows = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=specs,
+        out_specs=[
+            pl.BlockSpec((ROW_TILE, LANE), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(g_buf.shape, g_buf.dtype),
+            jax.ShapeDtypeStruct((g_buf.shape[0], 1), lr_rows.dtype),
+        ],
+        interpret=_interpret(),
+    )(ct, g_buf, lr_rows)
+    return ct, dg, dlr_rows
+
+
+_fused_sgd.defvjp(_fused_sgd_fwd, _fused_sgd_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def fused_sgd_update(params, grads, lr_tree, layout: PackedLayout = None):
+    """One LSLR SGD step ``p - lr_t * g`` over the whole pytree as a single
+    fused kernel. ``lr_tree`` holds one scalar per leaf (the learnable
+    per-tensor lrs). Differentiable in all three inputs (custom VJP), so it
+    composes with the second-order meta-gradient rollout."""
+    layout = layout or build_layout(params)
+    p_buf = pack(params, layout)
+    g_buf = pack(grads, layout)
+    lr_vec = jnp.stack([jnp.asarray(x).reshape(()) for x in jax.tree.leaves(lr_tree)])
+    # static gather: per-row lr; its VJP (segment scatter-add) routes the
+    # per-row lr cotangents from the kernel back to the per-tensor lrs.
+    lr_rows = lr_vec[jnp.asarray(layout.row_map)][:, None].astype(p_buf.dtype)
+    out = _fused_sgd(p_buf, g_buf, lr_rows)
+    return unpack(out, layout)

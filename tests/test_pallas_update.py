@@ -1,0 +1,122 @@
+"""Fused Pallas LSLR update (ops/pallas_update.py): packing round-trip, math
+parity with the plain per-leaf update, differentiability (incl. through a
+second-order rollout via the full MAMLSystem), all in Pallas interpret mode on
+the CPU test platform — the same code path compiles via Mosaic on TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.ops.inner_optim import build_inner_optimizer
+from howtotrainyourmamlpytorch_tpu.ops.pallas_update import (
+    build_layout,
+    fused_sgd_update,
+    pack,
+    unpack,
+)
+
+from .test_maml_core import _as_jnp, tiny_batch, tiny_config, tiny_linear_model
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+
+
+def _tree(seed=0):
+    k = jax.random.PRNGKey(seed)
+    ks = jax.random.split(k, 4)
+    return {
+        "conv": {"w": jax.random.normal(ks[0], (3, 3, 4, 8)), "b": jnp.zeros((8,))},
+        "head": {
+            "w": jax.random.normal(ks[1], (200, 5)),
+            "b": jax.random.normal(ks[2], (5,)),
+        },
+    }
+
+
+def _lrs(tree, base=0.1):
+    leaves, treedef = jax.tree.flatten(tree)
+    return jax.tree.unflatten(
+        treedef, [jnp.asarray(base * (i + 1)) for i in range(len(leaves))]
+    )
+
+
+def test_pack_unpack_roundtrip():
+    tree = _tree()
+    layout = build_layout(tree)
+    buf = pack(tree, layout)
+    assert buf.shape[1] == 128 and buf.shape[0] % 256 == 0
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b), tree, unpack(buf, layout)
+    )
+
+
+def test_fused_matches_plain_update():
+    params, grads = _tree(0), _tree(1)
+    lrs = _lrs(params)
+    fused = fused_sgd_update(params, grads, lrs)
+    plain = jax.tree.map(lambda p, g, a: p - a * g, params, grads, lrs)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6),
+        fused,
+        plain,
+    )
+
+
+def test_fused_gradients_match_plain():
+    """d(scalar objective)/d{params, grads, lrs} identical through the fused
+    kernel's custom VJP and the plain jnp path."""
+    params, grads = _tree(0), _tree(1)
+    lrs = _lrs(params)
+    target = _tree(2)
+
+    def objective(update_fn, p, g, a):
+        new = update_fn(p, g, a)
+        return sum(
+            jnp.sum((x - t) ** 2) for x, t in zip(jax.tree.leaves(new), jax.tree.leaves(target))
+        )
+
+    plain_fn = lambda p, g, a: jax.tree.map(lambda x, y, z: x - z * y, p, g, a)
+    g_fused = jax.grad(lambda *args: objective(fused_sgd_update, *args), argnums=(0, 1, 2))(
+        params, grads, lrs
+    )
+    g_plain = jax.grad(lambda *args: objective(plain_fn, *args), argnums=(0, 1, 2))(
+        params, grads, lrs
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g_fused,
+        g_plain,
+    )
+
+
+def test_fused_inner_optimizer_dispatch():
+    opt = build_inner_optimizer("sgd", lr=0.1, fused=True)
+    params, grads = _tree(0), _tree(1)
+    hp = opt.init_hparams(params)
+    new_params, state = opt.update(grads, (), params, hp)
+    plain = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6),
+        new_params,
+        plain,
+    )
+
+
+def test_full_meta_step_parity_fused_vs_plain():
+    """The flagship check: one full second-order MAML++ train step (MSL on,
+    learnable lrs) produces identical losses/params/learned-lrs with the
+    fused Pallas inner update and the plain path."""
+    results = {}
+    for fused in (False, True):
+        cfg = tiny_config(use_pallas_inner_update=fused)
+        system = MAMLSystem(cfg, model=tiny_linear_model())
+        state = system.init_train_state()
+        batch = _as_jnp(tiny_batch())
+        state, out = system.train_step(state, batch, epoch=0)
+        results[fused] = (float(out.loss), state)
+    loss_p, state_p = results[False]
+    loss_f, state_f = results[True]
+    np.testing.assert_allclose(loss_f, loss_p, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        (state_f.params, state_f.inner_hparams),
+        (state_p.params, state_p.inner_hparams),
+    )
