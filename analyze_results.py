@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""CLI for results analysis — the reference's ``nbs/2019.09.14.plot.ipynb``
+pipeline as a command (see ``howtotrainyourmamlpytorch_tpu/analysis.py``).
+
+Usage:
+    python analyze_results.py exps/ --out analysis_out/ --min-seeds 3
+"""
+
+import argparse
+import json
+
+from howtotrainyourmamlpytorch_tpu.analysis import write_report
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("exps_root", help="experiments root (e.g. exps/)")
+    parser.add_argument("--out", default="analysis_out", help="report output dir")
+    parser.add_argument(
+        "--min-seeds",
+        type=int,
+        default=1,
+        help="only aggregate ablation cells with >= this many finished seeds "
+        "(the notebook uses 3)",
+    )
+    args = parser.parse_args()
+    result = write_report(args.exps_root, args.out, min_seeds=args.min_seeds)
+    print(json.dumps({k: v for k, v in result.items() if k != "plots"}, indent=1))
+    for p in result["plots"]:
+        print(p)
+
+
+if __name__ == "__main__":
+    main()

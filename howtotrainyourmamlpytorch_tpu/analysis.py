@@ -1,0 +1,315 @@
+"""Results analysis — the reference's plotting notebook as a library.
+
+The reference ships its analysis as ``nbs/2019.09.14.plot.ipynb``: cells 2-6
+load each sweep run's ``config.yaml`` + ``logs/*.csv``, cells 8-11 aggregate
+meta-test accuracy over seeds per (dataset, n_way, k_shot, model, inner_optim)
+into a LaTeX table (keeping only groups where all seeds finished — cell 8
+filters ``count == 3``), and cells 13-14 plot the learned per-tensor inner-opt
+learning rates / Adam betas over epochs from ``lrs.csv`` / ``betas.csv``.
+
+This module is the same pipeline as importable functions over the identical
+artifact contract (experiment/storage.py), plus a CLI (``analyze_results.py``)
+that emits markdown + LaTeX tables and PNG plots instead of notebook cells.
+"""
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import yaml
+
+from .experiment import storage
+
+
+@dataclasses.dataclass
+class RunRecord:
+    """Everything the notebook reads from one run directory."""
+
+    run_dir: str
+    config: Dict[str, Any]
+    # one dict per epoch from logs/summary_statistics.csv
+    summary: List[Dict[str, float]]
+    # rows of logs/test_summary.csv (usually one)
+    test: List[Dict[str, float]]
+    # [epochs, n_tensors] learned per-tensor lrs; None if not recorded
+    lrs: Optional[np.ndarray] = None
+    # [epochs, 2*n_tensors] interleaved (b1, b2) per tensor; None unless Adam
+    betas: Optional[np.ndarray] = None
+
+    # -- the ablation axes the notebook groups by (cells 8-11) --------------
+    @property
+    def dataset(self) -> str:
+        return self.config.get("dataset", {}).get("name", "?")
+
+    @property
+    def n_way(self) -> int:
+        return int(self.config.get("num_classes_per_set", 0))
+
+    @property
+    def k_shot(self) -> int:
+        return int(self.config.get("num_samples_per_class", 0))
+
+    @property
+    def net(self) -> str:
+        return self.config.get("net", "?")
+
+    @property
+    def inner_optim(self) -> str:
+        opt = self.config.get("inner_optim", {})
+        return opt.get("kind", "?") if isinstance(opt, dict) else str(opt)
+
+    @property
+    def seed(self) -> int:
+        return int(self.config.get("seed", 0))
+
+    @property
+    def group_key(self) -> Tuple[str, int, int, str, str]:
+        return (self.dataset, self.n_way, self.k_shot, self.net, self.inner_optim)
+
+    @property
+    def test_accuracy(self) -> Optional[float]:
+        if not self.test:
+            return None
+        return float(self.test[-1]["test_accuracy_mean"])
+
+
+def _read_csv_rows(path: str) -> List[Dict[str, float]]:
+    if not os.path.exists(path):
+        return []
+    rows = storage.load_statistics(os.path.dirname(path), os.path.basename(path))
+    return [{k: storage._scalar(v) for k, v in row.items()} for row in rows]
+
+
+def _read_hparam_csv(path: str) -> Optional[np.ndarray]:
+    """lrs.csv / betas.csv: header-less comma-joined floats, one row per epoch
+    (storage.append_hparam_row; reference few_shot_learning_system.py:366-376)."""
+    if not os.path.exists(path):
+        return None
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append([float(v) for v in line.split(",")])
+    if not rows:
+        return None
+    width = max(len(r) for r in rows)
+    return np.array([r + [np.nan] * (width - len(r)) for r in rows], np.float64)
+
+
+def load_run(run_dir: str) -> Optional[RunRecord]:
+    """Load one run directory (notebook cells 2-3); None if it has no config."""
+    cfg_path = os.path.join(run_dir, "config.yaml")
+    if not os.path.exists(cfg_path):
+        return None
+    with open(cfg_path) as f:
+        config = yaml.safe_load(f) or {}
+    logs = os.path.join(run_dir, "logs")
+    return RunRecord(
+        run_dir=run_dir,
+        config=config,
+        summary=_read_csv_rows(os.path.join(logs, "summary_statistics.csv")),
+        test=_read_csv_rows(os.path.join(logs, "test_summary.csv")),
+        lrs=_read_hparam_csv(os.path.join(run_dir, "lrs.csv")),
+        betas=_read_hparam_csv(os.path.join(run_dir, "betas.csv")),
+    )
+
+
+def collect_runs(exps_root: str) -> List[RunRecord]:
+    """Walk an experiments root and load every run dir (one with config.yaml)."""
+    runs = []
+    for dirpath, dirnames, filenames in os.walk(exps_root):
+        if "config.yaml" in filenames:
+            run = load_run(dirpath)
+            if run is not None:
+                runs.append(run)
+            dirnames[:] = []  # run dirs don't nest
+    return sorted(runs, key=lambda r: r.run_dir)
+
+
+# ---------------------------------------------------------------------------
+# Aggregation (notebook cells 8-11)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AggregateRow:
+    dataset: str
+    n_way: int
+    k_shot: int
+    net: str
+    inner_optim: str
+    mean: float  # test accuracy, percent
+    std: float
+    count: int  # seeds aggregated
+
+
+def aggregate_test_accuracy(
+    runs: Sequence[RunRecord], min_seeds: int = 1
+) -> List[AggregateRow]:
+    """Mean/std of meta-test accuracy over seeds per ablation cell.
+
+    The notebook keeps only cells where all 3 seeds finished (cell 8 filters
+    ``count == 3``); ``min_seeds`` generalizes that threshold.
+    Accuracies are reported in percent (the baseline-table convention).
+    """
+    groups: Dict[Tuple[str, int, int, str, str], List[float]] = {}
+    for run in runs:
+        acc = run.test_accuracy
+        if acc is None:
+            continue
+        groups.setdefault(run.group_key, []).append(acc * 100.0)
+    rows = []
+    for key in sorted(groups):
+        accs = np.asarray(groups[key], np.float64)
+        if len(accs) < min_seeds:
+            continue
+        rows.append(
+            AggregateRow(*key, mean=float(accs.mean()), std=float(accs.std()), count=len(accs))
+        )
+    return rows
+
+
+_TABLE_HEADER = ["Dataset", "N-way", "K-shot", "Model", "Inner opt", "Test acc (%)", "Std", "Seeds"]
+
+
+def to_markdown(rows: Sequence[AggregateRow]) -> str:
+    lines = [
+        "| " + " | ".join(_TABLE_HEADER) + " |",
+        "|" + "|".join("---" for _ in _TABLE_HEADER) + "|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r.dataset} | {r.n_way} | {r.k_shot} | {r.net} | {r.inner_optim} "
+            f"| {r.mean:.2f} | {r.std:.2f} | {r.count} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def _tex(s: str) -> str:
+    """Escape text-mode LaTeX specials in names ('omniglot_dataset' etc.)."""
+    for ch in "&%$#_{}":
+        s = s.replace(ch, "\\" + ch)
+    return s
+
+
+def to_latex(rows: Sequence[AggregateRow]) -> str:
+    """The notebook cell-11 style LaTeX table (mean ± std per ablation cell)."""
+    lines = [
+        "\\begin{tabular}{llllll}",
+        "\\toprule",
+        "Dataset & N-way & K-shot & Model & Inner opt & Test acc (\\%) \\\\",
+        "\\midrule",
+    ]
+    for r in rows:
+        lines.append(
+            f"{_tex(r.dataset)} & {r.n_way} & {r.k_shot} & {_tex(r.net)} & "
+            f"{_tex(r.inner_optim)} & ${r.mean:.2f} \\pm {r.std:.2f}$ \\\\"
+        )
+    lines += ["\\bottomrule", "\\end{tabular}"]
+    return "\n".join(lines) + "\n"
+
+
+def best_per_config(rows: Sequence[AggregateRow]) -> List[AggregateRow]:
+    """Best (model, inner_optim) per (dataset, n_way, k_shot) — the headline
+    'Best' column of the baseline table (notebook cells 9-10)."""
+    best: Dict[Tuple[str, int, int], AggregateRow] = {}
+    for r in rows:
+        key = (r.dataset, r.n_way, r.k_shot)
+        if key not in best or r.mean > best[key].mean:
+            best[key] = r
+    return [best[k] for k in sorted(best)]
+
+
+# ---------------------------------------------------------------------------
+# Plots (notebook cells 4-6, 13-14) — matplotlib, headless
+# ---------------------------------------------------------------------------
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def plot_learning_curves(run: RunRecord, out_path: str) -> Optional[str]:
+    """Train/val accuracy + loss vs epoch (notebook cells 4-6)."""
+    if not run.summary:
+        return None
+    plt = _plt()
+    epochs = [r.get("epoch", i) for i, r in enumerate(run.summary)]
+    fig, (ax_acc, ax_loss) = plt.subplots(1, 2, figsize=(11, 4))
+    for split, style in (("train", "-"), ("val", "--")):
+        acc = [r.get(f"{split}_accuracy_mean") for r in run.summary]
+        loss = [r.get(f"{split}_loss_mean") for r in run.summary]
+        if any(v is not None for v in acc):
+            ax_acc.plot(epochs, acc, style, label=split)
+        if any(v is not None for v in loss):
+            ax_loss.plot(epochs, loss, style, label=split)
+    ax_acc.set_xlabel("epoch"), ax_acc.set_ylabel("accuracy"), ax_acc.legend()
+    ax_loss.set_xlabel("epoch"), ax_loss.set_ylabel("loss"), ax_loss.legend()
+    fig.suptitle(os.path.basename(run.run_dir.rstrip("/")))
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path
+
+
+def plot_inner_opt_stats(run: RunRecord, out_path: str) -> Optional[str]:
+    """Learned per-tensor lrs (and Adam betas) over epochs (cells 13-14)."""
+    if run.lrs is None:
+        return None
+    plt = _plt()
+    n_panels = 1 + (run.betas is not None)
+    fig, axes = plt.subplots(1, n_panels, figsize=(6 * n_panels, 4), squeeze=False)
+    ax = axes[0][0]
+    for j in range(run.lrs.shape[1]):
+        ax.plot(run.lrs[:, j], lw=0.8)
+    ax.set_xlabel("epoch"), ax.set_ylabel("inner lr"), ax.set_title("learned per-tensor lrs")
+    if run.betas is not None:
+        ax = axes[0][1]
+        for j in range(run.betas.shape[1]):
+            # interleaved b1, b2 per tensor (runner.write_inner_opt_stats)
+            ax.plot(run.betas[:, j], lw=0.8, ls="-" if j % 2 == 0 else ":")
+        ax.set_xlabel("epoch"), ax.set_ylabel("beta"), ax.set_title("learned Adam betas (b1 solid, b2 dotted)")
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path
+
+
+# ---------------------------------------------------------------------------
+# End-to-end report (what the notebook produces, as files)
+# ---------------------------------------------------------------------------
+
+
+def write_report(exps_root: str, out_dir: str, min_seeds: int = 1) -> Dict[str, Any]:
+    """Analyze every run under ``exps_root`` into ``out_dir``: aggregate
+    markdown/LaTeX/JSON tables + per-run curve and inner-opt-stat plots."""
+    os.makedirs(out_dir, exist_ok=True)
+    runs = collect_runs(exps_root)
+    rows = aggregate_test_accuracy(runs, min_seeds=min_seeds)
+    with open(os.path.join(out_dir, "test_accuracy.md"), "w") as f:
+        f.write(to_markdown(rows))
+        best = best_per_config(rows)
+        if best:
+            f.write("\nBest (model, inner-opt) per config:\n\n" + to_markdown(best))
+    with open(os.path.join(out_dir, "test_accuracy.tex"), "w") as f:
+        f.write(to_latex(rows))
+    with open(os.path.join(out_dir, "test_accuracy.json"), "w") as f:
+        json.dump([dataclasses.asdict(r) for r in rows], f, indent=1)
+    plots = []
+    for run in runs:
+        # stem from the run dir's path relative to the sweep root, so
+        # same-basename runs in different sweep subdirs don't collide
+        rel = os.path.relpath(run.run_dir, exps_root).replace(os.sep, ".")
+        stem = rel + f".seed{run.seed}"
+        p = plot_learning_curves(run, os.path.join(out_dir, f"{stem}.curves.png"))
+        q = plot_inner_opt_stats(run, os.path.join(out_dir, f"{stem}.inner_opt.png"))
+        plots += [x for x in (p, q) if x]
+    return {"runs": len(runs), "table_rows": len(rows), "plots": plots, "out_dir": out_dir}

@@ -1,0 +1,63 @@
+"""Results archiver — the TPU build's stand-in for the reference's
+results-uploader.
+
+The reference ships a PyDrive Google-Drive uploader only as compiled bytecode
+(``utils/__pycache__/gdrive_utils.cpython-36.pyc``: ``get_drive``,
+``get_folder_id``, ``delete_file``) — an aux tool for shipping experiment
+artifacts off the training machine. TPU pods have no interactive OAuth flow
+and this environment has no egress, so the equivalent here is local-first:
+pack a run's artifacts (logs, configs, learned-hparam CSVs — NOT the large
+checkpoints unless asked) into a single tar.gz that any transport (gsutil,
+scp, a results bucket) can ship, plus list/delete management of the archive
+dir mirroring the uploader's folder management.
+"""
+
+import os
+import tarfile
+import time
+from typing import List, Optional
+
+#: artifact names worth shipping (checkpoints excluded by default — they are
+#: the bulk of a run dir and rarely wanted off-device)
+_DEFAULT_INCLUDE = ("logs", "config.yaml", "lrs.csv", "betas.csv", "visual_outputs")
+
+
+def pack_run(
+    run_dir: str,
+    archive_dir: str,
+    include_checkpoints: bool = False,
+    archive_name: Optional[str] = None,
+) -> str:
+    """Tar a run directory's artifacts into ``archive_dir``; returns the path."""
+    run_dir = run_dir.rstrip("/")
+    if not os.path.isdir(run_dir):
+        raise FileNotFoundError(run_dir)
+    os.makedirs(archive_dir, exist_ok=True)
+    stem = archive_name or os.path.basename(run_dir)
+    base = os.path.join(archive_dir, f"{stem}.{time.strftime('%Y%m%d-%H%M%S')}")
+    out_path, n = base + ".tar.gz", 0
+    while os.path.exists(out_path):  # same stem in the same second
+        n += 1
+        out_path = f"{base}.{n}.tar.gz"
+    include = _DEFAULT_INCLUDE + (("saved_models",) if include_checkpoints else ())
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name in include:
+            path = os.path.join(run_dir, name)
+            if os.path.exists(path):
+                tar.add(path, arcname=os.path.join(os.path.basename(run_dir), name))
+    return out_path
+
+
+def list_archives(archive_dir: str) -> List[str]:
+    if not os.path.isdir(archive_dir):
+        return []
+    return sorted(
+        os.path.join(archive_dir, f) for f in os.listdir(archive_dir) if f.endswith(".tar.gz")
+    )
+
+
+def delete_archive(path: str) -> None:
+    """Remove one archive (the uploader's ``delete_file`` management op)."""
+    if not path.endswith(".tar.gz"):
+        raise ValueError(f"refusing to delete non-archive path {path!r}")
+    os.remove(path)

@@ -1,0 +1,129 @@
+"""Analysis pipeline (analysis.py) + results archiver over synthetic run dirs
+that follow the storage artifact contract exactly."""
+
+import os
+import tarfile
+
+import numpy as np
+import yaml
+
+from howtotrainyourmamlpytorch_tpu import analysis
+from howtotrainyourmamlpytorch_tpu.experiment import storage
+from howtotrainyourmamlpytorch_tpu.utils import results_archive
+
+
+def _make_run(root, name, *, seed, net="vgg", inner="sgd", test_acc=0.95, epochs=3,
+              betas=False):
+    run_dir = os.path.join(root, name)
+    _, logs, _ = storage.build_experiment_folder(run_dir)
+    cfg = {
+        "dataset": {"name": "omniglot_dataset"},
+        "num_classes_per_set": 5,
+        "num_samples_per_class": 1,
+        "net": net,
+        "inner_optim": {"kind": inner},
+        "seed": seed,
+    }
+    with open(os.path.join(run_dir, "config.yaml"), "w") as f:
+        yaml.safe_dump(cfg, f)
+    for epoch in range(epochs):
+        storage.save_statistics(
+            logs,
+            {
+                "epoch": epoch,
+                "train_accuracy_mean": 0.5 + 0.1 * epoch,
+                "train_loss_mean": 1.0 - 0.1 * epoch,
+                "val_accuracy_mean": 0.4 + 0.1 * epoch,
+                "val_loss_mean": 1.2 - 0.1 * epoch,
+            },
+        )
+        storage.append_hparam_row(run_dir, [0.1 + 0.01 * epoch] * 4, "lrs.csv")
+        if betas:
+            storage.append_hparam_row(run_dir, [0.5, 0.5] * 4, "betas.csv")
+    storage.save_statistics(
+        logs, {"test_accuracy_mean": test_acc, "test_loss_mean": 0.2},
+        filename="test_summary.csv",
+    )
+    return run_dir
+
+
+def test_load_run_and_collect(tmp_path):
+    root = str(tmp_path)
+    _make_run(root, "a.seed0", seed=0)
+    _make_run(root, "a.seed1", seed=1, betas=True, inner="adam")
+    runs = analysis.collect_runs(root)
+    assert len(runs) == 2
+    run = runs[0]
+    assert run.group_key == ("omniglot_dataset", 5, 1, "vgg", "sgd")
+    assert run.test_accuracy == 0.95
+    assert run.lrs.shape == (3, 4)
+    assert runs[1].betas.shape == (3, 8)
+
+
+def test_aggregate_mean_std_and_min_seeds(tmp_path):
+    root = str(tmp_path)
+    _make_run(root, "a.seed0", seed=0, test_acc=0.90)
+    _make_run(root, "a.seed1", seed=1, test_acc=0.94)
+    _make_run(root, "b.seed0", seed=0, net="resnet-4", test_acc=0.99)
+    rows = analysis.aggregate_test_accuracy(analysis.collect_runs(root))
+    assert len(rows) == 2
+    by_net = {r.net: r for r in rows}
+    np.testing.assert_allclose(by_net["vgg"].mean, 92.0)
+    np.testing.assert_allclose(by_net["vgg"].std, 2.0)
+    assert by_net["vgg"].count == 2
+    # the notebook's count==3 filter, generalized
+    rows2 = analysis.aggregate_test_accuracy(analysis.collect_runs(root), min_seeds=2)
+    assert [r.net for r in rows2] == ["vgg"]
+    best = analysis.best_per_config(rows)
+    assert len(best) == 1 and best[0].net == "resnet-4"
+
+
+def test_tables_and_report(tmp_path):
+    root, out = str(tmp_path / "exps"), str(tmp_path / "out")
+    _make_run(root, "a.seed0", seed=0, betas=True, inner="adam")
+    rows = analysis.aggregate_test_accuracy(analysis.collect_runs(root))
+    md, tex = analysis.to_markdown(rows), analysis.to_latex(rows)
+    assert "| vgg | adam |" in md.replace("  ", " ")
+    assert "\\pm" in tex and "95.00" in tex
+    # names with underscores must be text-mode escaped for pdflatex
+    assert "omniglot\\_dataset" in tex
+    result = analysis.write_report(root, out)
+    assert result["runs"] == 1 and result["table_rows"] == 1
+    assert os.path.exists(os.path.join(out, "test_accuracy.md"))
+    assert os.path.exists(os.path.join(out, "test_accuracy.tex"))
+    # curves + inner-opt plots rendered
+    assert len(result["plots"]) == 2
+    for p in result["plots"]:
+        assert os.path.getsize(p) > 0
+
+
+def test_report_sweep_layout_no_plot_collisions(tmp_path):
+    # sweep layout exps/{config}/{seed_N}: same basename under different
+    # parents must produce distinct plot files
+    root, out = str(tmp_path / "exps"), str(tmp_path / "out")
+    _make_run(os.path.join(root, "cfg_a"), "seed_0", seed=0)
+    _make_run(os.path.join(root, "cfg_b"), "seed_0", seed=0, net="resnet-4")
+    result = analysis.write_report(root, out)
+    assert result["runs"] == 2
+    assert len(result["plots"]) == len(set(result["plots"])) == 4
+
+
+def test_results_archive_roundtrip(tmp_path):
+    run_dir = _make_run(str(tmp_path), "a.seed0", seed=0)
+    # a fake checkpoint that must be excluded by default
+    with open(os.path.join(run_dir, "saved_models", "train_model_0"), "wb") as f:
+        f.write(b"x" * 100)
+    archive_dir = str(tmp_path / "archives")
+    path = results_archive.pack_run(run_dir, archive_dir)
+    with tarfile.open(path) as tar:
+        names = tar.getnames()
+    assert any("summary_statistics.csv" in n for n in names)
+    assert any(n.endswith("config.yaml") for n in names)
+    assert not any("saved_models" in n for n in names)
+    path2 = results_archive.pack_run(run_dir, archive_dir, include_checkpoints=True,
+                                     archive_name="with-ckpt")
+    with tarfile.open(path2) as tar:
+        assert any("saved_models" in n for n in tar.getnames())
+    assert set(results_archive.list_archives(archive_dir)) == {path, path2}
+    results_archive.delete_archive(path)
+    assert results_archive.list_archives(archive_dir) == [path2]
