@@ -100,16 +100,39 @@ class FewShotDataset:
 
     def _load_into_memory(self) -> None:
         """Pre-decode every image to float32 NHWC arrays (reference RAM cache,
-        data.py:220-237) so the episode hot path is pure numpy gather."""
+        data.py:220-237) so the episode hot path is pure gather.
+
+        The cache is one contiguous packed buffer per split; the per-class
+        entries in ``self.datasets`` become views into it, and
+        ``self.packed[split] = (buffer, {class_key: offset})`` feeds the
+        native C++ episode-assembly engine (native/episode_engine.cpp)."""
         import concurrent.futures
 
-        def load_class(item):
-            key, file_list = item
-            return key, np.stack([self._load_image(f) for f in file_list])
-
+        self.packed = {}
+        H, W, C = self.spec.image_shape
         for split, classes in self.datasets.items():
+            if not classes:
+                continue
+            # preallocate the packed buffer (sizes known up front) and decode
+            # directly into per-class slices: peak RAM = 1x the cache
+            total = sum(len(v) for v in classes.values())
+            buffer = np.empty((total, H, W, C), np.float32)
+            offsets, views, pos = {}, {}, 0
+            for key, file_list in classes.items():
+                offsets[key] = pos
+                views[key] = buffer[pos : pos + len(file_list)]
+                pos += len(file_list)
+
+            def load_class(item):
+                key, file_list = item
+                dst = views[key]
+                for i, f in enumerate(file_list):
+                    dst[i] = self._load_image(f)
+
             with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
-                self.datasets[split] = dict(pool.map(load_class, classes.items()))
+                list(pool.map(load_class, classes.items()))
+            self.datasets[split] = views
+            self.packed[split] = (buffer, offsets)
         self.in_memory = True
 
     # ------------------------------------------------------------------
@@ -148,35 +171,95 @@ class FewShotDataset:
     # episode sampling (reference get_set, data.py:486-532)
     # ------------------------------------------------------------------
 
+    def _draw_episode(self, rng: np.random.RandomState, split: str):
+        """The reference's exact RandomState call sequence for one episode
+        (data.py:493-508): n_way classes w/o replacement, shuffle, one rot-k
+        per class, then k+t sample indices per class w/o replacement."""
+        counts = self.class_counts[split]
+        n_samples = self.num_samples_per_class + self.num_target_samples
+        selected = rng.choice(list(counts.keys()), size=self.num_classes_per_set, replace=False)
+        rng.shuffle(selected)
+        k_list = rng.randint(0, 4, size=self.num_classes_per_set)
+        sample_idx = [
+            rng.choice(counts[key], size=n_samples, replace=False) for key in selected
+        ]
+        return selected, k_list, sample_idx
+
+    def _split_episode(self, x: np.ndarray, y: np.ndarray) -> Dict[str, np.ndarray]:
+        # per-episode (5D) outputs stay views — _stack's np.stack is the one
+        # copy on that path; the batched native (6D) output is final, so force
+        # contiguity there for the device transfer.
+        copy = np.ascontiguousarray if x.ndim == 6 else (lambda a: a)
+        k_shot = self.num_samples_per_class
+        return {
+            "x_support": copy(x[..., :k_shot, :, :, :]),
+            "x_target": copy(x[..., k_shot:, :, :, :]),
+            "y_support": np.ascontiguousarray(y[..., :k_shot]),
+            "y_target": np.ascontiguousarray(y[..., k_shot:]),
+        }
+
+    def _labels(self, *lead_shape) -> np.ndarray:
+        n_way = self.num_classes_per_set
+        n_samples = self.num_samples_per_class + self.num_target_samples
+        y = np.arange(n_way, dtype=np.int32)[:, None]
+        return np.broadcast_to(y, lead_shape + (n_way, n_samples))
+
     def sample_episode(self, split: str, seed: int, augment: bool = False) -> Dict[str, np.ndarray]:
         spec = self.spec
         n_way = self.num_classes_per_set
-        k_shot = self.num_samples_per_class
-        n_target = self.num_target_samples
-        counts = self.class_counts[split]
+        n_samples = self.num_samples_per_class + self.num_target_samples
         rng = np.random.RandomState(seed)
-        selected = rng.choice(list(counts.keys()), size=n_way, replace=False)
-        rng.shuffle(selected)
-        k_list = rng.randint(0, 4, size=n_way)
+        selected, k_list, sample_idx = self._draw_episode(rng, split)
         x = np.empty(
-            (n_way, k_shot + n_target, spec.image_height, spec.image_width, spec.image_channels),
+            (n_way, n_samples, spec.image_height, spec.image_width, spec.image_channels),
             np.float32,
         )
         for ci, class_key in enumerate(selected):
-            sample_idx = rng.choice(counts[class_key], size=k_shot + n_target, replace=False)
             store = self.datasets[split][class_key]
-            for si, s in enumerate(sample_idx):
+            for si, s in enumerate(sample_idx[ci]):
                 arr = store[s] if self.in_memory else self._load_image(store[s])
                 x[ci, si] = self._postprocess(arr, int(k_list[ci]), augment)
-        y = np.broadcast_to(
-            np.arange(n_way, dtype=np.int32)[:, None], (n_way, k_shot + n_target)
+        return self._split_episode(x, self._labels())
+
+    def sample_episode_batch(
+        self, split: str, seeds, augment: bool = False
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """Whole meta-batch in ONE native call (C++ engine, native/): the
+        RandomState draws happen here (bit-exact with sample_episode via
+        _draw_episode), then gather + rot90 + normalize + pack run in native
+        threads over the packed cache. Returns None when the native engine or
+        the packed RAM cache is unavailable — callers fall back to the
+        per-episode numpy path."""
+        if not self.in_memory or split not in getattr(self, "packed", {}):
+            return None
+        from .. import native
+
+        if native.load_engine() is None:
+            return None
+        buffer, offsets = self.packed[split]
+        n_way = self.num_classes_per_set
+        n_samples = self.num_samples_per_class + self.num_target_samples
+        B = len(seeds)
+        image_idx = np.empty((B, n_way, n_samples), np.int64)
+        rot_k = np.zeros((B, n_way), np.int32)
+        for b, seed in enumerate(seeds):
+            rng = np.random.RandomState(seed)
+            selected, k_list, sample_idx = self._draw_episode(rng, split)
+            for ci, class_key in enumerate(selected):
+                image_idx[b, ci] = offsets[class_key] + sample_idx[ci]
+            if self.spec.rotation_augmentation and augment:
+                rot_k[b] = k_list
+        mean = std = None
+        if not self.spec.rotation_augmentation and self.spec.normalize_mean:
+            mean = np.asarray(self.spec.normalize_mean, np.float32)
+            std = np.asarray(self.spec.normalize_std, np.float32)
+        x = native.assemble_episodes(
+            buffer, image_idx, rot_k, mean=mean, std=std,
+            num_threads=max(self.cfg.num_dataprovider_workers, 1),
         )
-        return {
-            "x_support": x[:, :k_shot],
-            "x_target": x[:, k_shot:],
-            "y_support": np.ascontiguousarray(y[:, :k_shot]),
-            "y_target": np.ascontiguousarray(y[:, k_shot:]),
-        }
+        if x is None:
+            return None
+        return self._split_episode(x, self._labels(B))
 
     def episode_seed(self, split: str, index: int) -> int:
         """seed = f(split, index): the whole task stream is a pure function of

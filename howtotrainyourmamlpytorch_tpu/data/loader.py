@@ -83,10 +83,16 @@ class MetaLearningDataLoader:
 
         def build(batch_idx: int) -> Dict[str, np.ndarray]:
             base = start_index + batch_idx * bs
+            seeds = [ds.episode_seed(split, base + j) for j in range(bs)]
+            # fast path: whole batch assembled by one native C++ call
+            # (gather+rot90+normalize+pack in native threads; ctypes releases
+            # the GIL, so prefetch still overlaps the device step)
+            batch = ds.sample_episode_batch(split, seeds, augment)
+            if batch is not None:
+                return batch
             episodes = list(
                 self._episode_pool.map(
-                    lambda j: ds.sample_episode(split, ds.episode_seed(split, base + j), augment),
-                    range(bs),
+                    lambda s: ds.sample_episode(split, s, augment), seeds
                 )
             )
             return _stack(episodes)
