@@ -186,14 +186,13 @@ class FewShotDataset:
         return selected, k_list, sample_idx
 
     def _split_episode(self, x: np.ndarray, y: np.ndarray) -> Dict[str, np.ndarray]:
-        # per-episode (5D) outputs stay views — _stack's np.stack is the one
-        # copy on that path; the batched native (6D) output is final, so force
-        # contiguity there for the device transfer.
-        copy = np.ascontiguousarray if x.ndim == 6 else (lambda a: a)
+        # x slices stay views — _stack's np.stack is the one copy on the
+        # per-episode path (the native batched path builds support/target
+        # contiguously up front and doesn't come through here)
         k_shot = self.num_samples_per_class
         return {
-            "x_support": copy(x[..., :k_shot, :, :, :]),
-            "x_target": copy(x[..., k_shot:, :, :, :]),
+            "x_support": x[..., :k_shot, :, :, :],
+            "x_target": x[..., k_shot:, :, :, :],
             "y_support": np.ascontiguousarray(y[..., :k_shot]),
             "y_target": np.ascontiguousarray(y[..., k_shot:]),
         }
@@ -253,13 +252,28 @@ class FewShotDataset:
         if not self.spec.rotation_augmentation and self.spec.normalize_mean:
             mean = np.asarray(self.spec.normalize_mean, np.float32)
             std = np.asarray(self.spec.normalize_std, np.float32)
-        x = native.assemble_episodes(
-            buffer, image_idx, rot_k, mean=mean, std=std,
-            num_threads=max(self.cfg.num_dataprovider_workers, 1),
+        # assemble support and target directly into separate contiguous
+        # buffers (two native calls over the pre-split index array): no
+        # post-hoc slicing copy of the just-built batch
+        k_shot = self.num_samples_per_class
+        threads = max(self.cfg.num_dataprovider_workers, 1)
+        x_support = native.assemble_episodes(
+            buffer, np.ascontiguousarray(image_idx[:, :, :k_shot]), rot_k,
+            mean=mean, std=std, num_threads=threads,
         )
-        if x is None:
+        x_target = native.assemble_episodes(
+            buffer, np.ascontiguousarray(image_idx[:, :, k_shot:]), rot_k,
+            mean=mean, std=std, num_threads=threads,
+        )
+        if x_support is None or x_target is None:
             return None
-        return self._split_episode(x, self._labels(B))
+        y = self._labels(B)
+        return {
+            "x_support": x_support,
+            "x_target": x_target,
+            "y_support": np.ascontiguousarray(y[..., :k_shot]),
+            "y_target": np.ascontiguousarray(y[..., k_shot:]),
+        }
 
     def episode_seed(self, split: str, index: int) -> int:
         """seed = f(split, index): the whole task stream is a pure function of

@@ -24,14 +24,23 @@ _engine_failed = False
 
 
 def _build() -> bool:
+    # compile to a process-unique temp path, then atomically os.replace into
+    # place: concurrent builders race harmlessly, and an interrupted g++ can
+    # never leave a partial .so at the canonical path
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        _SRC, "-o", _LIB,
+        _SRC, "-o", tmp,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
         return True
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -50,8 +59,20 @@ def load_engine() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(_LIB)
         except OSError:
-            _engine_failed = True
-            return None
+            # e.g. a corrupt .so from an older interrupted writer: remove and
+            # rebuild once before giving up on the native path
+            try:
+                os.remove(_LIB)
+            except OSError:
+                pass
+            if not _build():
+                _engine_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError:
+                _engine_failed = True
+                return None
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
         lib.assemble_episodes.restype = ctypes.c_int
         lib.assemble_episodes.argtypes = [

@@ -105,12 +105,25 @@ def test_batched_native_path_bit_exact_vs_per_episode(omniglot_like):
                 np.testing.assert_array_equal(batch[key][b], ep[key], err_msg=key)
 
 
-def test_loader_uses_native_path_and_is_deterministic(omniglot_like):
+def test_loader_uses_native_path_and_is_deterministic(omniglot_like, monkeypatch):
+    _engine_or_skip()
     cfg, ds = omniglot_like
+    calls = {"batch": 0}
+    orig = ds.sample_episode_batch
+    monkeypatch.setattr(
+        ds, "sample_episode_batch",
+        lambda *a, **kw: calls.__setitem__("batch", calls["batch"] + 1) or orig(*a, **kw),
+    )
+    monkeypatch.setattr(
+        ds, "sample_episode",
+        lambda *a, **kw: pytest.fail("loader fell back to the per-episode path"),
+    )
     loader = MetaLearningDataLoader(cfg, dataset=ds)
     b1 = next(iter(loader.val_batches(1)))
     b2 = next(iter(loader.val_batches(1)))
+    assert calls["batch"] == 2  # native batch path actually served both
     assert b1["x_support"].shape == (3, 4, 2, 28, 28, 1)
+    assert all(v.flags["C_CONTIGUOUS"] for v in b1.values())
     for key in b1:
         np.testing.assert_array_equal(b1[key], b2[key])
     loader.close()
