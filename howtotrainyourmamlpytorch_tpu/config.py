@@ -89,6 +89,19 @@ class Config:
     def __post_init__(self):
         # normalize so YAML round-trips compare equal
         self.train_val_test_split = list(self.train_val_test_split)
+        if self.checkpoint_rotation not in ("latest", "best_val"):
+            raise ValueError(
+                f"checkpoint_rotation must be 'latest' or 'best_val', "
+                f"got {self.checkpoint_rotation!r}"
+            )
+        if self.test_ensemble_top_k > 1 and self.checkpoint_rotation != "best_val":
+            # with latest-N rotation the best-val epochs may already be
+            # deleted, silently degrading the documented top-K-by-val-accuracy
+            # ensemble semantics
+            raise ValueError(
+                "test_ensemble_top_k > 1 requires checkpoint_rotation='best_val' "
+                "so the top validation checkpoints are actually retained"
+            )
 
     # --- episode shape (reference config.yaml:22-26) ---
     num_classes_per_set: int = 20
@@ -122,6 +135,15 @@ class Config:
     total_iter_per_epoch: int = 500
     continue_from_epoch: str = "latest"
     evaluate_on_test_set_only: bool = False
+    # checkpoint rotation policy: "latest" keeps the most recent
+    # max_models_to_save epoch files (reference-like), "best_val" keeps the
+    # top ones by validation accuracy (upstream MAML++ kept best-5 for test
+    # ensembling; SURVEY.md §2.9 item 4)
+    checkpoint_rotation: str = "latest"
+    # test-time ensembling: average softmax probabilities of the top-K
+    # saved checkpoints by val accuracy (1 = best model only, the default;
+    # upstream MAML++ ensembled its top 5)
+    test_ensemble_top_k: int = 1
     meta_learning_rate: float = 0.001
     min_learning_rate: float = 1.0e-05
 

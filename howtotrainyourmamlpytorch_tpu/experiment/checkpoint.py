@@ -56,23 +56,31 @@ def save_checkpoint(
     bookkeeping: Dict[str, Any],
     epoch: int,
     max_models_to_save: int = 5,
+    val_acc_by_epoch: Optional[Dict[int, float]] = None,
 ) -> str:
+    """Write ``train_model_{epoch}`` + ``train_model_latest`` and rotate.
+
+    Rotation keeps ``max_models_to_save`` per-epoch files: the most recent
+    ones by default, or — when ``val_acc_by_epoch`` is given — the top ones by
+    validation accuracy (upstream MAML++ kept its best-5 val models for test
+    ensembling; SURVEY.md §2.9 item 4)."""
     blob = _serialize(state, bookkeeping)
     path = _path(save_dir, epoch)
     for target in (path, _path(save_dir, "latest")):
         _write_atomic(target, blob)
-    _rotate(save_dir, max_models_to_save)
+    _rotate(save_dir, max_models_to_save, val_acc_by_epoch)
     return path
 
 
-def _rotate(save_dir: str, keep: int) -> None:
-    pattern = re.compile(rf"^{MODEL_NAME}_(\d+)$")
-    epochs = sorted(
-        int(m.group(1))
-        for name in os.listdir(save_dir)
-        if (m := pattern.match(name))
-    )
-    for epoch in epochs[:-keep] if keep > 0 else []:
+def _rotate(save_dir: str, keep: int, val_acc_by_epoch: Optional[Dict[int, float]] = None) -> None:
+    if keep <= 0:
+        return
+    epochs = available_epochs(save_dir)
+    if val_acc_by_epoch is not None:
+        # drop lowest-val-acc first; epochs missing a recorded val acc (e.g.
+        # from an older run) rank lowest, ties broken oldest-first
+        epochs = sorted(epochs, key=lambda e: (val_acc_by_epoch.get(e, -1.0), e))
+    for epoch in epochs[:-keep]:
         os.remove(_path(save_dir, epoch))
 
 

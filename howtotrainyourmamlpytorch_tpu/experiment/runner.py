@@ -58,6 +58,10 @@ class ExperimentRunner:
         self.start_epoch = 0
         self.best_val_accuracy = -1.0
         self.best_val_epoch = -1
+        # epoch -> val accuracy, for best_val checkpoint rotation and top-K
+        # test ensembling (persisted in checkpoint bookkeeping)
+        self.val_acc_by_epoch: Dict[int, float] = {}
+        self._profiled = False
         idx = cfg.continue_from_epoch
         resumable = idx not in ("", "scratch", None)
         if resumable and not ckpt.checkpoint_exists(self.saved_models_dir, idx):
@@ -78,6 +82,10 @@ class ExperimentRunner:
             self.start_epoch = int(bookkeeping.get("epoch", -1)) + 1
             self.best_val_accuracy = float(bookkeeping.get("best_val_accuracy", -1.0))
             self.best_val_epoch = int(bookkeeping.get("best_val_epoch", -1))
+            self.val_acc_by_epoch = {
+                int(k): float(v)
+                for k, v in (bookkeeping.get("val_acc_by_epoch") or {}).items()
+            }
             storage.change_json_log_experiment_status(
                 self.logs_dir, self.experiment_name, f"resumed at epoch {self.start_epoch}"
             )
@@ -108,10 +116,22 @@ class ExperimentRunner:
         cfg = self.cfg
         losses, accs, lr = [], [], 0.0
         start = time.time()
-        for batch in self.loader.train_batches(cfg.total_iter_per_epoch, augment_images=True):
+        # profiling window (SURVEY.md §5.1): trace iters [10, 20) of the first
+        # trained epoch — past compile/warmup, short enough to inspect
+        profile_this_epoch = bool(cfg.profile_dir) and not self._profiled
+        prof_start, prof_stop = (10, 20) if cfg.total_iter_per_epoch >= 20 else (0, 1)
+        for it, batch in enumerate(
+            self.loader.train_batches(cfg.total_iter_per_epoch, augment_images=True)
+        ):
+            if profile_this_epoch and it == prof_start:
+                jax.profiler.start_trace(cfg.profile_dir)
             # epoch passed host-side: program-variant selection without a
             # device sync, so step dispatch overlaps episode assembly
             self.state, out = self.system.train_step(self.state, self._put(batch), epoch=epoch)
+            if profile_this_epoch and it == prof_stop - 1:
+                out.loss.block_until_ready()
+                jax.profiler.stop_trace()
+                self._profiled = True
             losses.append(out.loss)
             accs.append(out.accuracy)
             lr = out.learning_rate
@@ -172,6 +192,7 @@ class ExperimentRunner:
             "best_val_accuracy": self.best_val_accuracy,
             "best_val_epoch": self.best_val_epoch,
             "train_episodes_produced": self.loader.train_episodes_produced,
+            "val_acc_by_epoch": {str(k): v for k, v in self.val_acc_by_epoch.items()},
         }
         ckpt.save_checkpoint(
             self.saved_models_dir,
@@ -179,6 +200,11 @@ class ExperimentRunner:
             bookkeeping,
             epoch,
             self.cfg.max_models_to_save,
+            val_acc_by_epoch=(
+                self.val_acc_by_epoch
+                if self.cfg.checkpoint_rotation == "best_val"
+                else None
+            ),
         )
 
     def _save_best(self) -> None:
@@ -196,10 +222,56 @@ class ExperimentRunner:
 
     # ------------------------------------------------------------------
 
+    def _collect_test_probs(self, state: TrainState, batches):
+        """Per-batch softmax target probabilities for pre-assembled test
+        batches (the test stream is fixed-seed, so every ensemble member sees
+        identical episodes — assembled once by the caller)."""
+        probs = []
+        for batch in batches:
+            out = self.system.eval_step(state, self._put(batch))
+            probs.append(np.asarray(jax.nn.softmax(out.per_task_target_logits, axis=-1)))
+        return probs
+
     def evaluate_test(self) -> Dict[str, Any]:
-        """Best-val-model test evaluation -> logs/test_summary.csv (reference
-        contract: nbs cell 3/6 reads test_accuracy_mean)."""
-        stats = self._eval_split("test")
+        """Test evaluation -> logs/test_summary.csv (reference contract: nbs
+        cell 3/6 reads test_accuracy_mean). With ``test_ensemble_top_k > 1``,
+        softmax probabilities of the top-K saved checkpoints by validation
+        accuracy are averaged per episode (upstream MAML++'s best-5 val-model
+        ensembling; SURVEY.md §2.9 item 4)."""
+        k = max(self.cfg.test_ensemble_top_k, 1)
+        ranked = sorted(
+            (e for e in ckpt.available_epochs(self.saved_models_dir)
+             if e in self.val_acc_by_epoch),
+            key=lambda e: self.val_acc_by_epoch[e],
+            reverse=True,
+        )[:k] if k > 1 else []
+        if len(ranked) > 1:
+            n_batches = max(self.cfg.num_evaluation_tasks // self.loader.batch_size, 1)
+            batches = list(self.loader.test_batches(n_batches))  # assembled once
+            labels = [b["y_target"].reshape(b["y_target"].shape[0], -1) for b in batches]
+            template = jax.device_get(self.state)
+            member_probs = []
+            for epoch in ranked:
+                state, _ = ckpt.load_checkpoint(self.saved_models_dir, epoch, template)
+                member_probs.append(self._collect_test_probs(state, batches))
+            accs, losses = [], []
+            for b, y in enumerate(labels):
+                mean_probs = np.mean([m[b] for m in member_probs], axis=0)
+                accs.append(float((mean_probs.argmax(-1) == y).mean()))
+                true_p = np.take_along_axis(mean_probs, y[..., None], axis=-1)
+                losses.append(float(-np.log(np.maximum(true_p, 1e-12)).mean()))
+            acc_mean, acc_std = _mean_std(accs)
+            loss_mean, loss_std = _mean_std(losses)
+            stats = {
+                "test_loss_mean": loss_mean,
+                "test_loss_std": loss_std,
+                "test_accuracy_mean": acc_mean,
+                "test_accuracy_std": acc_std,
+                "test_ensemble_size": len(ranked),
+                "test_ensemble_epochs": " ".join(str(e) for e in ranked),
+            }
+        else:
+            stats = self._eval_split("test")
         storage.save_statistics(self.logs_dir, stats, filename="test_summary.csv")
         storage.change_json_log_experiment_status(
             self.logs_dir, self.experiment_name,
@@ -224,6 +296,7 @@ class ExperimentRunner:
             )
             storage.append_jsonl(self.logs_dir, {"ts": time.time(), **stats})
             self.write_inner_opt_stats()
+            self.val_acc_by_epoch[epoch] = float(stats["val_accuracy_mean"])
             if stats["val_accuracy_mean"] > self.best_val_accuracy:
                 self.best_val_accuracy = stats["val_accuracy_mean"]
                 self.best_val_epoch = epoch

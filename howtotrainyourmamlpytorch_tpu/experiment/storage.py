@@ -31,12 +31,28 @@ def build_experiment_folder(experiment_dir: str) -> Tuple[str, str, str]:
 
 def save_statistics(log_dir: str, statistics: Dict[str, Any], filename: str = "summary_statistics.csv") -> str:
     """Append one row; writes the header on first use (reference
-    utils/storage.py:17-28)."""
+    utils/storage.py:17-28). If the new row's columns differ from the existing
+    header (e.g. a later run appends ensemble columns), the file is rewritten
+    under the union of columns so rows never go positionally misaligned."""
     path = os.path.join(log_dir, filename)
-    exists = os.path.exists(path)
+    fieldnames = list(statistics.keys())
+    if os.path.exists(path):
+        with open(path, newline="") as f:
+            reader = csv.DictReader(f)
+            existing_fields = reader.fieldnames or []
+            if existing_fields != fieldnames:
+                rows = list(reader)
+                merged = list(existing_fields) + [
+                    k for k in fieldnames if k not in existing_fields
+                ]
+                with open(path, "w", newline="") as g:
+                    writer = csv.DictWriter(g, fieldnames=merged, restval="")
+                    writer.writeheader()
+                    writer.writerows(rows)
+                fieldnames = merged
     with open(path, "a", newline="") as f:
-        writer = csv.DictWriter(f, fieldnames=list(statistics.keys()))
-        if not exists:
+        writer = csv.DictWriter(f, fieldnames=fieldnames, restval="")
+        if f.tell() == 0:
             writer.writeheader()
         writer.writerow({k: _scalar(v) for k, v in statistics.items()})
     return path
