@@ -140,9 +140,24 @@ def load_or_build_index(
 def check_dataset_integrity(data_path: str, dataset_name: str) -> int:
     """Count images and validate against the expected totals (reference
     ``utils/dataset_tools.py:29-40``) — fail fast on mismatch rather than the
-    reference's delete-and-recurse loop."""
+    reference's delete-and-recurse loop. The pkl-packed mini-imagenet variant
+    (reference accepts exactly 3 ``.pkl`` files, dataset_tools.py:37-40) is
+    validated by its pickle count."""
     if not os.path.exists(data_path):
         raise FileNotFoundError(f"dataset dir missing: {data_path}")
+    if "pkl" in dataset_name:
+        total = sum(
+            1
+            for _, _, names in os.walk(data_path)
+            for n in names
+            if n.lower().endswith(".pkl")
+        )
+        if total != 3:
+            raise RuntimeError(
+                f"{dataset_name}: found {total} .pkl files, expected 3 "
+                "(train/val/test pickles); dataset appears incomplete"
+            )
+        return total
     total = 0
     for _, _, names in os.walk(data_path):
         total += sum(1 for n in names if n.lower().endswith(_IMAGE_EXTS))

@@ -41,10 +41,25 @@ class MetaLearningDataLoader:
         dataset: Optional[FewShotDataset] = None,
         current_iter: int = 0,
         data_root: Optional[str] = None,
+        host_shard: Optional[tuple] = None,
     ):
+        """``host_shard=(process_index, process_count)`` makes this loader
+        materialize only its host's contiguous slice of each *global*
+        meta-batch (multi-host SPMD input: combine the local arrays with
+        ``parallel.global_batch_from_local``). Episode seeds stay a pure
+        function of the global stream position, so every host agrees on the
+        episode assignment and resume cursors remain global."""
         self.cfg = cfg
         self.dataset = dataset or FewShotDataset(cfg, data_root=data_root)
         self.batch_size = cfg.batch_size * cfg.samples_per_iter
+        if host_shard is not None:
+            from ..parallel import host_shard_bounds
+
+            self._local_lo, self._local_hi = host_shard_bounds(
+                self.batch_size, host_shard[0], host_shard[1]
+            )
+        else:
+            self._local_lo, self._local_hi = 0, self.batch_size
         self.num_workers = max(cfg.num_dataprovider_workers, 1)
         self.train_episodes_produced = 0
         self.continue_from_iter(current_iter)
@@ -83,7 +98,11 @@ class MetaLearningDataLoader:
 
         def build(batch_idx: int) -> Dict[str, np.ndarray]:
             base = start_index + batch_idx * bs
-            seeds = [ds.episode_seed(split, base + j) for j in range(bs)]
+            # this host's slice of the global batch (whole batch by default)
+            seeds = [
+                ds.episode_seed(split, base + j)
+                for j in range(self._local_lo, self._local_hi)
+            ]
             # fast path: whole batch assembled by one native C++ call
             # (gather+rot90+normalize+pack in native threads; ctypes releases
             # the GIL, so prefetch still overlaps the device step)

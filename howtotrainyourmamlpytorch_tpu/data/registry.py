@@ -27,6 +27,17 @@ class DatasetSpec:
 
 
 def get_dataset_spec(dataset_name: str) -> DatasetSpec:
+    if dataset_name.endswith("pkl"):
+        # the pkl-packed mini-imagenet variant is integrity-checkable
+        # (check_dataset_integrity counts its 3 pickles, matching reference
+        # utils/dataset_tools.py:37-40) but — exactly as in the reference
+        # snapshot, whose data.py only walks image folders — not loadable.
+        # Fail here, at dataset construction, with a clear remedy.
+        raise ValueError(
+            f"dataset {dataset_name!r}: the pkl-packed variant cannot be "
+            "loaded (no pickle episode reader, matching the reference's data "
+            "pipeline); unpack it to the image-folder layout instead"
+        )
     if "omniglot" in dataset_name:
         return DatasetSpec(
             indexes_of_folders_indicating_class=(-3, -2),

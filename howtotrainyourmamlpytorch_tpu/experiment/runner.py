@@ -24,7 +24,7 @@ import numpy as np
 from ..config import Config, save_config
 from ..core import MAMLSystem, TrainState
 from ..data import FewShotDataset, MetaLearningDataLoader
-from ..parallel import batch_sharding, make_mesh, replicate
+from ..parallel import batch_sharding, global_batch_from_local, make_mesh, replicate
 from ..utils.trees import named_leaves
 from . import checkpoint as ckpt
 from . import storage
@@ -90,25 +90,43 @@ class ExperimentRunner:
                 self.logs_dir, self.experiment_name, f"resumed at epoch {self.start_epoch}"
             )
 
-        self.loader = loader or MetaLearningDataLoader(
-            cfg, current_iter=self.start_epoch * cfg.total_iter_per_epoch, data_root=data_root
-        )
-
         # --- mesh / sharding (no-op on one device) ---
+        global_batch_size = cfg.batch_size * cfg.samples_per_iter
         self.mesh = None
         if cfg.parallel.shard_meta_batch and len(jax.devices()) > 1:
-            self.mesh = make_mesh(cfg.parallel)
-            dp = self.mesh.shape["dp"]
-            if self.loader.batch_size % dp == 0:
+            mesh = make_mesh(cfg.parallel)
+            if global_batch_size % mesh.shape["dp"] == 0:
+                self.mesh = mesh
                 self.state = replicate(self.state, self.mesh)
                 self._batch_sharding = batch_sharding(self.mesh)
-            else:
-                self.mesh = None  # meta-batch not divisible; fall back to 1 device
+            # else: meta-batch not divisible; fall back to 1 device
+
+        # multi-host SPMD: each host materializes only its slice of the global
+        # meta-batch; _put stitches the global sharded arrays (SURVEY.md §5.8).
+        # Host-sharding without a mesh would mean every host silently training
+        # alone on a fraction of the batch — fail fast instead.
+        self._multihost = jax.process_count() > 1
+        if self._multihost and self.mesh is None:
+            raise RuntimeError(
+                "multi-host run but no usable device mesh: enable "
+                "parallel.shard_meta_batch and make batch_size divisible by dp"
+            )
+        host_shard = (
+            (jax.process_index(), jax.process_count()) if self._multihost else None
+        )
+        self.loader = loader or MetaLearningDataLoader(
+            cfg,
+            current_iter=self.start_epoch * cfg.total_iter_per_epoch,
+            data_root=data_root,
+            host_shard=host_shard,
+        )
 
     # ------------------------------------------------------------------
 
     def _put(self, batch: Dict[str, np.ndarray]):
         if self.mesh is not None:
+            if self._multihost:
+                return global_batch_from_local(batch, self.mesh, self._batch_sharding)
             return jax.tree.map(lambda x: jax.device_put(x, self._batch_sharding), batch)
         return jax.tree.map(jax.device_put, batch)
 

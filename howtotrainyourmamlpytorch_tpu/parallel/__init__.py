@@ -2,6 +2,8 @@ from .mesh import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,
     batch_sharding,
+    global_batch_from_local,
+    host_shard_bounds,
     initialize_distributed,
     make_mesh,
     replicate,

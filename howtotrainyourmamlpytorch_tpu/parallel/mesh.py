@@ -74,3 +74,30 @@ def initialize_distributed(
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def host_shard_bounds(batch_size: int, process_index: int, process_count: int):
+    """[start, stop) of the global meta-batch this host materializes. The
+    global batch divides evenly over hosts (enforced), so every host builds
+    ``batch_size // process_count`` episodes of each global batch."""
+    if batch_size % process_count != 0:
+        raise ValueError(
+            f"global batch_size {batch_size} not divisible by "
+            f"process_count {process_count}"
+        )
+    per_host = batch_size // process_count
+    return process_index * per_host, (process_index + 1) * per_host
+
+
+def global_batch_from_local(local_batch, mesh: Mesh, sharding: Optional[NamedSharding] = None):
+    """Assemble per-host local episode arrays into global jax.Arrays sharded
+    over the mesh's ``dp`` axis (multi-host SPMD input path: each host feeds
+    only its shard; ``jax.make_array_from_process_local_data`` stitches the
+    global view over DCN — SURVEY.md §5.8). Pass a cached ``sharding`` on hot
+    paths to preserve sharding-identity caching downstream."""
+    if sharding is None:
+        sharding = batch_sharding(mesh)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        local_batch,
+    )
