@@ -151,7 +151,20 @@ class FewShotDataset:
                     arr = arr[:, :, None]
                 return arr  # binary 0/1 values, deliberately no /255
             image = image.resize((spec.image_height, spec.image_width)).convert("RGB")
-            return np.array(image, np.float32) / 255.0
+            arr = np.array(image, np.float32) / 255.0
+            if self.cfg.reverse_channels:
+                # RGB -> BGR flip BEFORE normalization, the reference order
+                # (load_batch: load_image -> preprocess_data flip on raw /255
+                # data, data.py:422,458-463; Normalize runs later inside
+                # augment_image, data.py:514-517). Applied at decode time so
+                # the RAM cache — and therefore the native batched path —
+                # inherit it; NB the reference skips the flip entirely on its
+                # RAM-cache path (data.py:412-417), an upstream inconsistency
+                # we resolve in favor of the flag meaning what it says.
+                # Returned as a view: every consumer copies into its own
+                # buffer anyway.
+                arr = arr[..., ::-1]
+            return arr
 
     def _postprocess(self, arr: np.ndarray, k: int, augment: bool) -> np.ndarray:
         """Per-image transform: rotation-k for omniglot train episodes

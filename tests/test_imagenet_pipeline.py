@@ -72,6 +72,27 @@ def test_native_batch_bit_exact_with_normalization(mini_imagenet_like):
             np.testing.assert_array_equal(batch[key][b], ep[key], err_msg=key)
 
 
+def test_reverse_channels_flips_rgb(mini_imagenet_like, tmp_path):
+    import dataclasses
+
+    cfg, ds = mini_imagenet_like
+    cfg_rev = dataclasses.replace(cfg, reverse_channels=True, load_into_memory=False)
+    cfg_fwd = dataclasses.replace(cfg, load_into_memory=False)
+    ds_rev, ds_fwd = FewShotDataset(cfg_rev), FewShotDataset(cfg_fwd)
+    seed = ds_fwd.episode_seed("train", 3)
+    a = ds_fwd.sample_episode("train", seed)["x_support"]
+    b = ds_rev.sample_episode("train", seed)["x_support"]
+    # normalization is channelwise, so compare pre-normalized by denormalizing
+    from howtotrainyourmamlpytorch_tpu.data.registry import get_dataset_spec
+
+    spec = get_dataset_spec(cfg.dataset.name)
+    mean = np.asarray(spec.normalize_mean, np.float32)
+    std = np.asarray(spec.normalize_std, np.float32)
+    np.testing.assert_allclose(
+        (b * std + mean), (a * std + mean)[..., ::-1], atol=1e-6
+    )
+
+
 def test_meta_step_runs_on_imagenet_spec(mini_imagenet_like):
     from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
     from howtotrainyourmamlpytorch_tpu.models import build_vgg

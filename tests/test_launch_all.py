@@ -1,0 +1,25 @@
+"""Sweep launcher grid (the reference's missing launch-all.py capability)."""
+
+import importlib.util
+import os
+
+spec = importlib.util.spec_from_file_location(
+    "launch_all", os.path.join(os.path.dirname(__file__), "..", "launch_all.py")
+)
+launch_all = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(launch_all)
+
+
+def test_grid_covers_published_sweep():
+    all_jobs = list(launch_all.jobs())
+    # 6 episode configs x 6 nets x 3 inner opts x 3 seeds
+    assert len(all_jobs) == 6 * 6 * 3 * 3
+    names = [n for n, _ in all_jobs]
+    assert len(set(names)) == len(names)
+    # every baseline-table headline config is present
+    for probe in ("omniglot.5.1.resnet-4.gd.0", "imagenet.5.5.resnet-8.gd.2",
+                  "omniglot.20.1.resnet-12.gd.1", "omniglot.20.5.densenet-8.rprop.0"):
+        assert probe in names
+    # overrides are self-consistent key=value strings
+    for _, overrides in all_jobs[:5]:
+        assert all("=" in o for o in overrides)
