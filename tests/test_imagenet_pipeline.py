@@ -72,7 +72,7 @@ def test_native_batch_bit_exact_with_normalization(mini_imagenet_like):
             np.testing.assert_array_equal(batch[key][b], ep[key], err_msg=key)
 
 
-def test_reverse_channels_flips_rgb(mini_imagenet_like, tmp_path):
+def test_reverse_channels_flips_rgb(mini_imagenet_like):
     import dataclasses
 
     cfg, ds = mini_imagenet_like

@@ -23,3 +23,10 @@ def test_grid_covers_published_sweep():
     # overrides are self-consistent key=value strings
     for _, overrides in all_jobs[:5]:
         assert all("=" in o for o in overrides)
+    # imagenet jobs must honor the official class split (reference
+    # data.py:185-196) or results aren't comparable to BASELINE.md
+    for name, overrides in all_jobs:
+        if name.startswith("imagenet"):
+            assert "sets_are_pre_split=true" in overrides
+        else:
+            assert "sets_are_pre_split=true" not in overrides

@@ -1,0 +1,93 @@
+"""Behavioral coverage for schedule knobs that previously had only schema
+tests: total_epochs_before_pause, samples_per_iter, and the (restored)
+first-order/second-order epoch switch."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from howtotrainyourmamlpytorch_tpu.config import Config, DatasetConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data import FewShotDataset, MetaLearningDataLoader
+from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
+from howtotrainyourmamlpytorch_tpu.experiment.storage import load_statistics
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+
+
+@pytest.fixture(scope="module")
+def toy_dataset(tmp_path_factory):
+    root = tmp_path_factory.mktemp("data") / "omniglot_toy"
+    rng = np.random.RandomState(0)
+    for a in range(4):
+        for c in range(5):
+            d = root / f"alpha{a}" / f"char{c}"
+            d.mkdir(parents=True)
+            for i in range(6):
+                arr = (rng.rand(28, 28) > 0.5).astype(np.uint8) * 255
+                Image.fromarray(arr, mode="L").convert("1").save(d / f"{i}.png")
+    return str(root)
+
+
+def toy_cfg(toy_dataset, **overrides):
+    base = dict(
+        dataset=DatasetConfig(name="omniglot_toy", path=toy_dataset),
+        num_classes_per_set=3,
+        num_samples_per_class=1,
+        num_target_samples=1,
+        batch_size=2,
+        total_epochs=5,
+        total_iter_per_epoch=2,
+        num_evaluation_tasks=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        load_into_memory=True,
+        num_dataprovider_workers=2,
+        train_val_test_split=(0.6, 0.2, 0.2),
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def test_total_epochs_before_pause_limits_run(toy_dataset, tmp_path):
+    """reference config.yaml:49 — a run pauses after N epochs even when
+    total_epochs is larger; resuming continues from the pause point."""
+    cfg = toy_cfg(toy_dataset, total_epochs_before_pause=2,
+                  experiment_root=str(tmp_path), experiment_name="pause")
+    system = MAMLSystem(cfg, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4))
+    runner = ExperimentRunner(cfg, system=system)
+    runner.run_experiment()
+    import os
+    rows = load_statistics(os.path.join(runner.run_dir, "logs"))
+    assert len(rows) == 2  # paused, not 5
+    cfg2 = toy_cfg(toy_dataset, total_epochs_before_pause=2,
+                   experiment_root=str(tmp_path), experiment_name="pause")
+    system2 = MAMLSystem(cfg2, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4))
+    runner2 = ExperimentRunner(cfg2, system=system2)
+    assert runner2.start_epoch == 2
+    runner2.run_experiment()
+    assert len(load_statistics(os.path.join(runner.run_dir, "logs"))) == 4
+
+
+def test_samples_per_iter_inflates_batch(toy_dataset):
+    """reference data.py:584-589: DataLoader batch = num_of_gpus * batch_size
+    * samples_per_iter episodes."""
+    cfg = toy_cfg(toy_dataset, samples_per_iter=2)
+    loader = MetaLearningDataLoader(cfg, dataset=FewShotDataset(cfg))
+    assert loader.batch_size == 4
+    batch = next(iter(loader.val_batches(1)))
+    assert batch["x_support"].shape[0] == 4
+    loader.close()
+
+
+def test_first_order_to_second_order_epoch_switch(toy_dataset):
+    """The switch the reference accepts but ignores (SURVEY §2.2) works here:
+    second order iff second_order and epoch > first_order_to_second_order_epoch
+    (reference few_shot_learning_system.py:288-289)."""
+    cfg = toy_cfg(toy_dataset, first_order_to_second_order_epoch=2)
+    system = MAMLSystem(cfg, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4))
+    assert not system.use_second_order(0)
+    assert not system.use_second_order(2)
+    assert system.use_second_order(3)
+    cfg2 = toy_cfg(toy_dataset, second_order=False)
+    system2 = MAMLSystem(cfg2, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4))
+    assert not system2.use_second_order(100)
