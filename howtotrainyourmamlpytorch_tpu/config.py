@@ -70,7 +70,10 @@ class Config:
     num_dataprovider_workers: int = 4
     max_models_to_save: int = 5
     dataset: DatasetConfig = field(default_factory=DatasetConfig)
-    sets_are_pre_split: bool = False
+    # None = auto: True for mini-imagenet (whose class labels embed the
+    # official split, "train/n...", reference data.py:185-196 + the
+    # ${imagenet} config node), False otherwise. An explicit bool wins.
+    sets_are_pre_split: Optional[bool] = None
     load_from_npz_files: bool = False  # unused in reference code; kept for schema parity
     load_into_memory: bool = True
     samples_per_iter: int = 1
@@ -89,6 +92,8 @@ class Config:
     def __post_init__(self):
         # normalize so YAML round-trips compare equal
         self.train_val_test_split = list(self.train_val_test_split)
+        if self.sets_are_pre_split is None:
+            self.sets_are_pre_split = self.is_imagenet
         if self.checkpoint_rotation not in ("latest", "best_val"):
             raise ValueError(
                 f"checkpoint_rotation must be 'latest' or 'best_val', "

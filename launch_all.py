@@ -49,12 +49,6 @@ def jobs():
             f"val_seed={seed}",
             f"experiment_name={name}",
         ]
-        if ds == "imagenet":
-            # mini-imagenet's class labels embed the official split
-            # ("train/n...", reference data.py:185-196); without this the
-            # classes would be ratio-re-split and results would not be
-            # comparable to the published baseline
-            overrides.append("sets_are_pre_split=true")
         yield name, overrides
 
 

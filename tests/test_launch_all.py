@@ -23,10 +23,23 @@ def test_grid_covers_published_sweep():
     # overrides are self-consistent key=value strings
     for _, overrides in all_jobs[:5]:
         assert all("=" in o for o in overrides)
-    # imagenet jobs must honor the official class split (reference
-    # data.py:185-196) or results aren't comparable to BASELINE.md
-    for name, overrides in all_jobs:
-        if name.startswith("imagenet"):
-            assert "sets_are_pre_split=true" in overrides
-        else:
-            assert "sets_are_pre_split=true" not in overrides
+
+
+def test_imagenet_jobs_get_official_split_via_config_default():
+    """The pre-split invariant lives in Config (auto by dataset), so EVERY
+    path into dataset=imagenet honors the official class split — not just the
+    launcher (reference data.py:185-196)."""
+    from howtotrainyourmamlpytorch_tpu.config import load_config
+
+    for name, overrides in launch_all.jobs():
+        if name.startswith("imagenet.5.1.vgg.gd"):
+            cfg = load_config(overrides=overrides)
+            assert cfg.sets_are_pre_split is True
+            break
+    assert load_config(overrides=["dataset=imagenet"]).sets_are_pre_split is True
+    assert load_config(overrides=["dataset=omniglot"]).sets_are_pre_split is False
+    # an explicit value always wins over the auto default
+    assert (
+        load_config(overrides=["dataset=imagenet", "sets_are_pre_split=false"]).sets_are_pre_split
+        is False
+    )

@@ -28,12 +28,21 @@ def main(argv=None):
 
     import os
 
+    import jax
+
     if os.environ.get("JAX_PLATFORMS"):
         # Site hooks (e.g. a TPU-tunnel plugin) may override the platform
         # selection after capturing the env; re-assert the user's choice.
-        import jax
-
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    # Persistent XLA compilation cache: re-runs of the same (shape, config)
+    # programs skip the 20-40s first compile (overridable via the standard
+    # JAX_COMPILATION_CACHE_DIR env var).
+    if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.expanduser("~"), ".cache", "htymp_tpu_xla"),
+        )
 
     from howtotrainyourmamlpytorch_tpu.config import load_config
     from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
