@@ -92,8 +92,6 @@ class Config:
     def __post_init__(self):
         # normalize so YAML round-trips compare equal
         self.train_val_test_split = list(self.train_val_test_split)
-        if self.sets_are_pre_split is None:
-            self.sets_are_pre_split = self.is_imagenet
         if self.checkpoint_rotation not in ("latest", "best_val"):
             raise ValueError(
                 f"checkpoint_rotation must be 'latest' or 'best_val', "
@@ -194,6 +192,15 @@ class Config:
     @property
     def is_imagenet(self) -> bool:
         return "imagenet" in self.dataset.name
+
+    @property
+    def effective_sets_are_pre_split(self) -> bool:
+        """Resolve the None='auto by dataset' default at the USE site, so the
+        stored config keeps None and re-targeting a saved config to another
+        dataset re-derives the right split mode."""
+        if self.sets_are_pre_split is None:
+            return self.is_imagenet
+        return self.sets_are_pre_split
 
     def run_name(self) -> str:
         # reference hydra run-dir naming: {dataset}.{n_way}.{k_shot}.local

@@ -71,7 +71,7 @@ class FewShotDataset:
             cfg.reset_stored_filepaths,
             cache_dir=cfg.index_cache_dir or None,
         )
-        if cfg.sets_are_pre_split:
+        if cfg.effective_sets_are_pre_split:
             # labels look like "train/n01532829": group by the embedded split
             # name (reference data.py:185-196; needed for mini-imagenet)
             splits: Dict[str, Dict[str, List]] = {}

@@ -34,12 +34,24 @@ def test_imagenet_jobs_get_official_split_via_config_default():
     for name, overrides in launch_all.jobs():
         if name.startswith("imagenet.5.1.vgg.gd"):
             cfg = load_config(overrides=overrides)
-            assert cfg.sets_are_pre_split is True
+            assert cfg.effective_sets_are_pre_split is True
             break
-    assert load_config(overrides=["dataset=imagenet"]).sets_are_pre_split is True
-    assert load_config(overrides=["dataset=omniglot"]).sets_are_pre_split is False
+    assert load_config(overrides=["dataset=imagenet"]).effective_sets_are_pre_split is True
+    assert load_config(overrides=["dataset=omniglot"]).effective_sets_are_pre_split is False
     # an explicit value always wins over the auto default
     assert (
-        load_config(overrides=["dataset=imagenet", "sets_are_pre_split=false"]).sets_are_pre_split
+        load_config(
+            overrides=["dataset=imagenet", "sets_are_pre_split=false"]
+        ).effective_sets_are_pre_split
         is False
     )
+    # the stored value stays None (auto), so a saved config re-targeted to a
+    # different dataset re-derives the right split mode
+    import dataclasses
+
+    cfg_o = load_config(overrides=["dataset=omniglot"])
+    assert cfg_o.sets_are_pre_split is None
+    from howtotrainyourmamlpytorch_tpu.config import DATASET_PRESETS
+
+    cfg_i = dataclasses.replace(cfg_o, dataset=DATASET_PRESETS["imagenet"])
+    assert cfg_i.effective_sets_are_pre_split is True

@@ -223,6 +223,38 @@ def test_train_step_learns_synthetic_tasks():
     assert float(eval_out.accuracy) > 0.5
 
 
+@pytest.mark.parametrize("kind", ["adam", "rprop"])
+def test_train_step_learns_with_each_inner_optimizer(kind):
+    """Every inner-optimizer ablation axis trains end-to-end (reference
+    config.yaml:68-85 gd/rprop/adam nodes), incl. learnable per-tensor lrs.
+    Inner Adam at the reference's aggressive lr=0.1/beta=0.5 is high-variance
+    (its published Adam ablations carry std up to ±11.6 accuracy points), so
+    the assertion is adaptation above chance + finite, moving hyperparams —
+    not monotone loss. The outer->inner Adam moment warm-start (the reference
+    quirk, SURVEY §2.2) measurably *hurts* on this tiny task — chance-level
+    with it, 0.67 accuracy without — so it's disabled here; its mechanics are
+    pinned separately in test_warm_start_seeds_inner_adam_from_outer_state."""
+    cfg = tiny_config(
+        total_epochs=100, total_iter_per_epoch=50, meta_learning_rate=0.003,
+        warm_start_inner_opt_from_outer=False,
+        inner_optim=InnerOptimConfig(kind=kind, lr=0.03, beta1=0.5, beta2=0.5),
+    )
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    losses = []
+    for i in range(40):
+        batch = _as_jnp(learnable_synthetic_batch(2, 3, 2, 2, TINY_SHAPE, seed=i % 4))
+        state, out = system.train_step(state, batch, epoch=0)
+        losses.append(float(out.loss))
+    assert np.all(np.isfinite(losses)), (kind, losses)
+    ev = system.eval_step(state, _as_jnp(learnable_synthetic_batch(2, 3, 2, 2, TINY_SHAPE, seed=1)))
+    assert float(ev.accuracy) > 0.45, (kind, float(ev.accuracy))  # chance = 1/3
+    # learnable lr hparams moved and respect the projection floor
+    lr = float(np.asarray(state.inner_hparams["lr"]["w"]))
+    assert lr >= 1e-4 - 1e-8
+    assert lr != 0.03
+
+
 def test_learned_lrs_change_and_stay_projected():
     cfg = tiny_config()
     system = MAMLSystem(cfg, model=tiny_linear_model())
