@@ -123,6 +123,13 @@ class Config:
 
     # --- MAML++ core (reference config.yaml:41-56) ---
     learnable_inner_opt_params: bool = True
+    # Per-STEP learnable inner-opt hyperparams: original MAML++ LSLR learns a
+    # separate lr per (tensor, inner step); the bamos fork regressed this to
+    # per-tensor only (SURVEY.md §2.2 "per-tensor, not per-step"). False
+    # reproduces the fork; True restores upstream LSLR (hparams gain a
+    # leading [num_steps] axis; eval steps beyond the trained horizon reuse
+    # the last step's values). Requires learnable_inner_opt_params.
+    lslr_per_step: bool = False
     use_multi_step_loss_optimization: bool = True
     multi_step_loss_num_epochs: int = 10
     minimum_per_task_contribution: float = 0.01  # unused in reference; schema parity

@@ -107,11 +107,21 @@ class MAMLSystem:
     # state
     # ------------------------------------------------------------------
 
+    @property
+    def _per_step_hparams(self) -> bool:
+        return bool(self.cfg.lslr_per_step and self.cfg.learnable_inner_opt_params)
+
     def init_train_state(self, seed: Optional[int] = None) -> TrainState:
         key = seeding.model_init_key(self.cfg.seed if seed is None else seed)
         params, bn_state = self.model.init(key)
         if self.cfg.learnable_inner_opt_params:
             inner_hparams = self.inner_opt.init_hparams(params)
+            if self._per_step_hparams:
+                # upstream MAML++ LSLR: one value per (tensor, inner step)
+                K = self.cfg.number_of_training_steps_per_iter
+                inner_hparams = jax.tree.map(
+                    lambda a: jnp.tile(a, (K,) + (1,) * jnp.ndim(a)), inner_hparams
+                )
         else:
             inner_hparams = {}
         trainables = {"params": params, "hparams": inner_hparams}
@@ -195,22 +205,36 @@ class MAMLSystem:
             logits, _ = model.apply(p, bn_state, x, use_batch_stats=True)
             return logits.astype(jnp.float32)
 
-        def inner_update(p, opt_s):
+        def inner_update(p, opt_s, hp):
             def support_loss_fn(q):
                 return cross_entropy(forward(q, x_support), y_support)
 
             grads = jax.grad(support_loss_fn)(p)
             if not second_order:
                 grads = jax.tree.map(lax.stop_gradient, grads)
-            return self.inner_opt.update(grads, opt_s, p, hparams)
+            return self.inner_opt.update(grads, opt_s, p, hp)
+
+        # Per-step hparam sequence scanned as xs. Fork semantics (default):
+        # the same hparams every step (free broadcast). Upstream-LSLR mode
+        # (lslr_per_step): slice the leading step axis; eval horizons beyond
+        # the trained one reuse the last step's values.
+        if self._per_step_hparams:
+            K = self.cfg.number_of_training_steps_per_iter
+            idx = jnp.minimum(jnp.arange(num_steps), K - 1)
+            hp_seq = jax.tree.map(lambda a: a[idx], hparams)
+        else:
+            hp_seq = jax.tree.map(
+                lambda a: jnp.broadcast_to(a, (num_steps,) + jnp.shape(a)), hparams
+            )
 
         unroll = num_steps if self.cfg.unroll_inner_steps else 1
 
         if per_step_target:
 
-            def step(carry, weight):
+            def step(carry, xs):
+                weight, hp = xs
                 p, opt_s, _ = carry
-                p_new, opt_s_new = inner_update(p, opt_s)
+                p_new, opt_s_new = inner_update(p, opt_s, hp)
                 target_logits = forward(p_new, x_target)
                 target_loss = cross_entropy(target_logits, y_target)
                 return (p_new, opt_s_new, target_logits), weight * target_loss
@@ -219,18 +243,18 @@ class MAMLSystem:
                 step = jax.checkpoint(step, prevent_cse=False)
             logits0 = jnp.zeros((x_target.shape[0], self.cfg.num_classes_per_set))
             (_, _, final_logits), weighted_losses = lax.scan(
-                step, (params, inner_state, logits0), loss_weights, unroll=unroll
+                step, (params, inner_state, logits0), (loss_weights, hp_seq), unroll=unroll
             )
             return jnp.sum(weighted_losses), final_logits
 
-        def step(carry, _):
+        def step(carry, hp):
             p, opt_s = carry
-            return inner_update(p, opt_s), None
+            return inner_update(p, opt_s, hp), None
 
         if self.cfg.remat_inner_steps:
             step = jax.checkpoint(step, prevent_cse=False)
         (p_final, _), _ = lax.scan(
-            step, (params, inner_state), None, length=num_steps, unroll=unroll
+            step, (params, inner_state), hp_seq, unroll=unroll
         )
         final_logits = forward(p_final, x_target)
         return cross_entropy(final_logits, y_target), final_logits

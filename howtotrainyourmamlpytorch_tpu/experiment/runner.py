@@ -196,12 +196,16 @@ class ExperimentRunner:
         if not cfg.learnable_inner_opt_params:
             return
         hp = jax.device_get(self.state.inner_hparams)
-        lrs = [float(v) for _, v in named_leaves(hp["lr"])]
+        # per-tensor scalars (fork semantics) or [num_steps] vectors
+        # (lslr_per_step): flatten either into columns
+        lrs = [float(x) for _, v in named_leaves(hp["lr"]) for x in np.ravel(v)]
         storage.append_hparam_row(self.run_dir, lrs, "lrs.csv")
         if cfg.inner_optim.kind == "adam":
             betas = []
             for (_, b1), (_, b2) in zip(named_leaves(hp["beta1"]), named_leaves(hp["beta2"])):
-                betas.extend([float(b1), float(b2)])
+                betas.extend(
+                    [float(x) for pair in zip(np.ravel(b1), np.ravel(b2)) for x in pair]
+                )
             storage.append_hparam_row(self.run_dir, betas, "betas.csv")
 
     def _save(self, epoch: int) -> None:

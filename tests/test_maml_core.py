@@ -267,6 +267,41 @@ def test_learned_lrs_change_and_stay_projected():
     assert lr1 >= 1e-4 - 1e-8
 
 
+def test_per_step_lslr_restores_upstream_semantics():
+    """lslr_per_step=True: one learnable lr per (tensor, step) — upstream
+    MAML++ LSLR, which the reference fork regressed to per-tensor
+    (SURVEY §2.2). Checks shape, equivalence-at-init with the fork mode,
+    per-step divergence under training, and eval-horizon clamping."""
+    cfg = tiny_config(lslr_per_step=True, meta_learning_rate=0.01,
+                      number_of_evaluation_steps_per_iter=4)  # > train steps
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    K = cfg.number_of_training_steps_per_iter
+    assert np.asarray(state.inner_hparams["lr"]["w"]).shape == (K,)
+
+    # at init, per-step mode computes exactly what the fork mode computes
+    cfg_fork = tiny_config(meta_learning_rate=0.01)
+    system_fork = MAMLSystem(cfg_fork, model=tiny_linear_model())
+    state_fork = system_fork.init_train_state()
+    batch = _as_jnp(learnable_synthetic_batch(2, 3, 2, 2, TINY_SHAPE, seed=0))
+    state, out_ps = system.train_step(state, batch, epoch=0)  # state donated
+    _, out_fork = system_fork.train_step(state_fork, batch, epoch=0)
+    np.testing.assert_allclose(float(out_ps.loss), float(out_fork.loss), rtol=1e-5)
+
+    # training moves the per-step lrs apart (they get distinct gradients)
+    for i in range(8):
+        state, _ = system.train_step(
+            state, _as_jnp(learnable_synthetic_batch(2, 3, 2, 2, TINY_SHAPE, seed=i)), epoch=0
+        )
+    lr = np.asarray(state.inner_hparams["lr"]["w"])
+    assert lr.shape == (K,)
+    assert np.ptp(lr) > 0, lr  # steps diverged from each other
+    assert (lr >= 1e-4 - 1e-8).all()  # projection applies elementwise
+    # eval with a longer horizon than trained clamps to the last step's lr
+    ev = system.eval_step(state, batch)
+    assert np.isfinite(float(ev.loss))
+
+
 def test_vgg_meta_step_runs():
     """End-to-end meta-step through a real conv+BN backbone (small variant)."""
     cfg = tiny_config(num_classes_per_set=3)
