@@ -13,24 +13,105 @@ single-GPU wall-clock (run-dir mtimes, BASELINE.md) => 0.5-2.6 steps/s. We take
 the *fastest* plausible reference throughput, 2.6 steps/s, as the conservative
 baseline; ``vs_baseline`` = ours / 2.6.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+diagnostics ("platform", "mfu", "breakdown"). Failure modes are bounded: if
+the backend cannot be contacted within STARTUP_TIMEOUT_S the script prints a
+structured JSON error line and exits nonzero fast instead of hanging
+(round-1 failure mode: remote TPU backend UNAVAILABLE => 9-minute hang).
 """
 
 import json
+import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from howtotrainyourmamlpytorch_tpu.config import Config
-from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
-from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+# must be set before any protobuf import (xplane parsing, utils/profiling.py)
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 
 REFERENCE_STEPS_PER_SEC = 2.6  # fastest plausible single-GPU reference (see docstring)
+STARTUP_TIMEOUT_S = 90.0
+METRIC = "meta_steps_per_sec_omniglot20w5s_vgg_b8_5steps_2nd_order"
+
+# Dense bf16 peak FLOP/s per chip, keyed by substring of device_kind.
+_PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+]
+
+
+def _fail(msg: str, rc: int = 2) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": None,
+                "unit": "meta-steps/sec/chip",
+                "vs_baseline": None,
+                "error": msg,
+            }
+        ),
+        flush=True,
+    )
+    # os._exit: a hung backend-init thread must not keep the process alive
+    os._exit(rc)
+
+
+def _contact_device():
+    """First device contact, bounded by STARTUP_TIMEOUT_S (the backend may be
+    a tunneled remote TPU that hangs on init when unreachable)."""
+    import concurrent.futures
+
+    def probe():
+        import jax
+
+        dev = jax.devices()[0]
+        return jax.default_backend(), str(dev.device_kind), len(jax.devices())
+
+    ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    fut = ex.submit(probe)
+    try:
+        return fut.result(timeout=STARTUP_TIMEOUT_S)
+    except concurrent.futures.TimeoutError:
+        _fail(f"backend init did not complete within {STARTUP_TIMEOUT_S:.0f}s")
+    except Exception as e:  # backend UNAVAILABLE etc.
+        _fail(f"backend init failed: {type(e).__name__}: {e}")
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
 
 
 def main():
+    platform, device_kind, n_devices = _contact_device()
+    print(
+        f"bench: platform={platform} device_kind={device_kind!r} n_devices={n_devices}",
+        file=sys.stderr,
+        flush=True,
+    )
+
+    import jax
+    import jax.numpy as jnp
+
+    # persistent XLA cache (same dir as the training entry point): a re-run of
+    # this exact program skips the first compile entirely
+    if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.expanduser("~"), ".cache", "htymp_tpu_xla"),
+        )
+
+    from howtotrainyourmamlpytorch_tpu.config import Config
+    from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+    from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+
     # Reference defaults (omniglot 20-way 5-shot, vgg, B=8, 5 inner steps) with
     # the TPU-native training recipe: mixed precision (bfloat16 compute for the
     # MXU / half the HBM traffic; float32 master params, outer updates, and
@@ -62,8 +143,10 @@ def main():
     # warmup / compile. epoch is passed host-side (as the training loop does):
     # reading it from state.step would force a device sync per step and
     # serialize dispatch against execution.
+    t0 = time.perf_counter()
     state, out = system.train_step(state, batch, epoch=0)
     out.loss.block_until_ready()
+    print(f"bench: compile+warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     n_iters = 30
     start = time.perf_counter()
@@ -73,15 +156,62 @@ def main():
     elapsed = time.perf_counter() - start
     steps_per_sec = n_iters / elapsed
 
+    # --- MFU: model FLOPs per meta-step (XLA cost analysis of the exact
+    # compiled program) / chip dense-bf16 peak. ---
+    mfu = flops_per_step = None
+    try:
+        # same program variant the timed loop selected for epoch=0
+        lowered = system._compiled_train_step(
+            system.use_second_order(0), system.msl_active(0)
+        ).lower(state, batch)
+        try:
+            ca = lowered.cost_analysis()  # from HLO, no backend compile
+        except Exception:
+            ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops_per_step = float(ca.get("flops", 0.0)) or None
+        peak = _peak_flops(device_kind)
+        if flops_per_step and peak:
+            mfu = round(flops_per_step * steps_per_sec / peak, 5)
+    except Exception as e:
+        print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)
+
+    # --- device-time breakdown from a short jax.profiler trace ---
+    breakdown = None
+    try:
+        from howtotrainyourmamlpytorch_tpu.utils.profiling import device_time_breakdown
+
+        trace_dir = "/tmp/bench_trace"
+        n_prof = 5
+        jax.profiler.start_trace(trace_dir)
+        t0 = time.perf_counter()
+        for _ in range(n_prof):
+            state, out = system.train_step(state, batch, epoch=0)
+        out.loss.block_until_ready()
+        prof_wall = time.perf_counter() - t0
+        jax.profiler.stop_trace()
+        breakdown = device_time_breakdown(trace_dir)
+        if breakdown is not None:
+            breakdown["wall_ms_per_step"] = round(1e3 * prof_wall / n_prof, 3)
+            breakdown.pop("top_ops", None)  # keep the JSON line short
+    except Exception as e:
+        print(f"bench: profile breakdown unavailable: {e}", file=sys.stderr)
+
     print(
         json.dumps(
             {
-                "metric": "meta_steps_per_sec_omniglot20w5s_vgg_b8_5steps_2nd_order",
+                "metric": METRIC,
                 "value": round(steps_per_sec, 3),
                 "unit": "meta-steps/sec/chip",
                 "vs_baseline": round(steps_per_sec / REFERENCE_STEPS_PER_SEC, 3),
+                "platform": f"{platform}:{device_kind}",
+                "flops_per_step": flops_per_step,
+                "mfu": mfu,
+                "breakdown": breakdown,
             }
-        )
+        ),
+        flush=True,
     )
 
 

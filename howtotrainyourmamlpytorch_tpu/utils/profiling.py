@@ -1,0 +1,121 @@
+"""Device-time breakdown from a ``jax.profiler`` trace.
+
+The reference has no profiling at all (SURVEY.md §5.1); here a trace window is
+first-class (runner ``profile_dir``) and this module turns the written
+``*.xplane.pb`` into a 3-line device-time breakdown (compute / data-movement /
+other) without TensorBoard: the tensorboard profile plugin is incompatible
+with the installed TF in this image, so the xplane proto is parsed directly
+via ``tensorflow.tsl`` under the pure-python protobuf implementation.
+"""
+
+import glob
+import os
+from typing import Any, Dict, Optional
+
+# Op-name prefixes that are data movement (HBM<->HBM/infeed DMA), not MXU/VPU
+# compute. copy/slice dominate when layouts force relayout between ops.
+_DMA_PREFIXES = (
+    "copy",
+    "slice",
+    "dynamic-slice",
+    "dynamic-update-slice",
+    "transpose",
+    "reshape",
+    "bitcast",
+    "concatenate",
+    "infeed",
+    "outfeed",
+    "all-to-all",
+)
+_COMPUTE_PREFIXES = (
+    "fusion",
+    "convolution",
+    "dot",
+    "loop",
+    "scatter",
+    "gather",
+    "reduce",
+    "rng",
+    "select",
+    "while",
+    "custom-call",
+)
+
+
+def _categorize(op_name: str) -> str:
+    name = op_name.lower()
+    for p in _DMA_PREFIXES:
+        if name.startswith(p):
+            return "dma"
+    for p in _COMPUTE_PREFIXES:
+        if name.startswith(p):
+            return "compute"
+    return "other"
+
+
+def device_time_breakdown(trace_dir: str) -> Optional[Dict[str, Any]]:
+    """Aggregate per-op device busy time from the newest xplane in trace_dir.
+
+    Returns ``{"compute_frac", "dma_frac", "other_frac", "device_busy_ms",
+    "top_ops"}`` over the whole trace window, or None when no xplane / no
+    device plane is found. Fractions are of device *busy* time (events on the
+    device plane); wall time per step is the caller's to measure.
+    """
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "plugins", "profile", "*", "*.xplane.pb")),
+        key=os.path.getmtime,
+    )
+    if not paths:
+        return None
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # type: ignore
+    except Exception:
+        try:
+            from tsl.profiler.protobuf import xplane_pb2  # type: ignore
+        except Exception:
+            return None
+
+    xspace = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        xspace.ParseFromString(f.read())
+
+    device_planes = [
+        p
+        for p in xspace.planes
+        if p.name.startswith("/device:TPU:") or p.name.startswith("/device:CPU:0")
+    ]
+    # prefer TPU planes when both exist
+    tpu = [p for p in device_planes if "TPU" in p.name]
+    planes = tpu or device_planes
+    if not planes:
+        return None
+
+    per_op_ps: Dict[str, int] = {}
+    for plane in planes:
+        meta = plane.event_metadata
+        # device planes carry hierarchical lines ('XLA Modules', 'Steps')
+        # whose events span the same device time as the op-level 'XLA Ops'
+        # line — summing them all would double/triple-count busy time
+        op_lines = [l for l in plane.lines if l.name == "XLA Ops"] or list(plane.lines)
+        for line in op_lines:
+            for event in line.events:
+                name = meta[event.metadata_id].name if event.metadata_id in meta else "?"
+                per_op_ps[name] = per_op_ps.get(name, 0) + event.duration_ps
+
+    total_ps = sum(per_op_ps.values())
+    if total_ps == 0:
+        return None
+    cat_ps = {"compute": 0, "dma": 0, "other": 0}
+    for name, ps in per_op_ps.items():
+        cat_ps[_categorize(name)] += ps
+    top = sorted(per_op_ps.items(), key=lambda kv: -kv[1])[:8]
+    return {
+        "compute_frac": round(cat_ps["compute"] / total_ps, 4),
+        "dma_frac": round(cat_ps["dma"] / total_ps, 4),
+        "other_frac": round(cat_ps["other"] / total_ps, 4),
+        "device_busy_ms": round(total_ps / 1e9, 3),
+        "top_ops": [
+            {"op": name, "ms": round(ps / 1e9, 3)} for name, ps in top
+        ],
+    }
