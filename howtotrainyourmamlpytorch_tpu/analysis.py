@@ -79,7 +79,15 @@ def _read_csv_rows(path: str) -> List[Dict[str, float]]:
     if not os.path.exists(path):
         return []
     rows = storage.load_statistics(os.path.dirname(path), os.path.basename(path))
-    return [{k: storage._scalar(v) for k, v in row.items()} for row in rows]
+
+    def scalar_or_none(v):
+        # header-reconciled CSVs back-fill missing cells with '' — map exactly
+        # those to None so the 'is not None' filters (and matplotlib) skip
+        # them; legitimately-string columns (e.g. test_ensemble_epochs) pass
+        # through unchanged
+        return None if v == "" else storage._scalar(v)
+
+    return [{k: scalar_or_none(v) for k, v in row.items()} for row in rows]
 
 
 def _read_hparam_csv(path: str) -> Optional[np.ndarray]:

@@ -105,6 +105,15 @@ class Config:
                 "test_ensemble_top_k > 1 requires checkpoint_rotation='best_val' "
                 "so the top validation checkpoints are actually retained"
             )
+        if 0 < self.max_models_to_save < self.test_ensemble_top_k:
+            # (max_models_to_save <= 0 disables rotation = keep ALL
+            # checkpoints, so any K is satisfiable there)
+            # rotation keeps max_models_to_save checkpoints; a larger K can
+            # never be satisfied and would silently ensemble fewer members
+            raise ValueError(
+                f"test_ensemble_top_k ({self.test_ensemble_top_k}) cannot "
+                f"exceed max_models_to_save ({self.max_models_to_save})"
+            )
 
     # --- episode shape (reference config.yaml:22-26) ---
     num_classes_per_set: int = 20

@@ -152,7 +152,12 @@ class MAMLSystem:
         moments from the outer Adam's state (the *intent* of the reference's
         deepcopy at ``few_shot_learning_system.py:219-220``, without the
         one-task lag — decision documented in SURVEY.md §2.2 / config)."""
-        inner_state = self.inner_opt.init_state(params, hparams)
+        init_hp = hparams
+        if self._per_step_hparams:
+            # per-step (K,...)-shaped hparam leaves: state init (e.g. rprop's
+            # step_size = lr) must see one step's values, not the K-vector
+            init_hp = jax.tree.map(lambda a: a[0], hparams)
+        inner_state = self.inner_opt.init_state(params, init_hp)
         if not (
             self.cfg.warm_start_inner_opt_from_outer
             and self.inner_opt.name == "adam"

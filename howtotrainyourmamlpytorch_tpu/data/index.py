@@ -25,6 +25,8 @@ from typing import Dict, List, Optional, Tuple
 
 from PIL import Image
 
+from . import registry
+
 _IMAGE_EXTS = (".jpeg", ".png", ".jpg")
 
 # reference utils/dataset_tools.py:29-40 expected image counts
@@ -145,7 +147,7 @@ def check_dataset_integrity(data_path: str, dataset_name: str) -> int:
     validated by its pickle count."""
     if not os.path.exists(data_path):
         raise FileNotFoundError(f"dataset dir missing: {data_path}")
-    if "pkl" in dataset_name:
+    if registry.is_pkl_variant(dataset_name):
         total = sum(
             1
             for _, _, names in os.walk(data_path)

@@ -26,8 +26,16 @@ class DatasetSpec:
         return (self.image_height, self.image_width, self.image_channels)
 
 
+def is_pkl_variant(dataset_name: str) -> bool:
+    """Single predicate for the pkl-packed dataset naming (reference
+    utils/dataset_tools.py:37 keys on the name containing 'pkl'; we key on the
+    suffix so a name merely containing 'pkl' isn't misclassified — shared by
+    spec lookup and integrity check so they can never disagree)."""
+    return dataset_name.endswith("pkl")
+
+
 def get_dataset_spec(dataset_name: str) -> DatasetSpec:
-    if dataset_name.endswith("pkl"):
+    if is_pkl_variant(dataset_name):
         # the pkl-packed mini-imagenet variant is integrity-checkable
         # (check_dataset_integrity counts its 3 pickles, matching reference
         # utils/dataset_tools.py:37-40) but — exactly as in the reference

@@ -91,15 +91,27 @@ class ExperimentRunner:
             )
 
         # --- mesh / sharding (no-op on one device) ---
+        print(
+            f"platform={jax.default_backend()} devices={len(jax.devices())} "
+            f"processes={jax.process_count()}",
+            flush=True,
+        )
         global_batch_size = cfg.batch_size * cfg.samples_per_iter
         self.mesh = None
         if cfg.parallel.shard_meta_batch and len(jax.devices()) > 1:
             mesh = make_mesh(cfg.parallel)
-            if global_batch_size % mesh.shape["dp"] == 0:
-                self.mesh = mesh
-                self.state = replicate(self.state, self.mesh)
-                self._batch_sharding = batch_sharding(self.mesh)
-            # else: meta-batch not divisible; fall back to 1 device
+            if global_batch_size % mesh.shape["dp"] != 0:
+                # A silent fall-back to one device would be an 8x perf cliff on
+                # a pod slice — refuse instead (VERDICT r1 weak #4).
+                raise ValueError(
+                    f"meta-batch ({global_batch_size}) not divisible by dp="
+                    f"{mesh.shape['dp']}: adjust batch_size/samples_per_iter "
+                    "or parallel.dp, or set parallel.shard_meta_batch=false "
+                    "to deliberately train on a single device"
+                )
+            self.mesh = mesh
+            self.state = replicate(self.state, self.mesh)
+            self._batch_sharding = batch_sharding(self.mesh)
 
         # multi-host SPMD: each host materializes only its slice of the global
         # meta-batch; _put stitches the global sharded arrays (SURVEY.md §5.8).
@@ -110,6 +122,16 @@ class ExperimentRunner:
             raise RuntimeError(
                 "multi-host run but no usable device mesh: enable "
                 "parallel.shard_meta_batch and make batch_size divisible by dp"
+            )
+        if self._multihost and cfg.test_ensemble_top_k > 1:
+            # the ensemble path np.asarray's dp-sharded global logits (not
+            # fully addressable across hosts) and scores host-local labels
+            # against global logits — refuse at construction, not after a
+            # multi-day training run, until it gathers via
+            # multihost_utils.process_allgather.
+            raise NotImplementedError(
+                "test_ensemble_top_k > 1 is not supported on multi-host runs; "
+                "set test_ensemble_top_k=1 (single-model test evaluation)"
             )
         host_shard = (
             (jax.process_index(), jax.process_count()) if self._multihost else None
@@ -267,6 +289,14 @@ class ExperimentRunner:
             key=lambda e: self.val_acc_by_epoch[e],
             reverse=True,
         )[:k] if k > 1 else []
+        if k > 1 and len(ranked) < k:
+            print(
+                f"warning: test ensemble requested top_k={k} but only "
+                f"{len(ranked)} ranked checkpoints survive rotation "
+                f"(max_models_to_save={self.cfg.max_models_to_save}); "
+                f"{'ensembling ' + str(len(ranked)) if len(ranked) > 1 else 'falling back to single-model evaluation'}",
+                flush=True,
+            )
         if len(ranked) > 1:
             n_batches = max(self.cfg.num_evaluation_tasks // self.loader.batch_size, 1)
             batches = list(self.loader.test_batches(n_batches))  # assembled once

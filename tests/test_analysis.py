@@ -47,6 +47,25 @@ def _make_run(root, name, *, seed, net="vgg", inner="sgd", test_acc=0.95, epochs
     return run_dir
 
 
+def test_reconciled_csv_blank_cells_become_none(tmp_path):
+    """Regression (advisor r1): header-drift reconciliation back-fills ''
+    cells; they must load as None (not strings matplotlib would treat as
+    categorical) and plotting must skip them."""
+    run_dir = _make_run(tmp_path, "drift", seed=0, epochs=2)
+    logs = os.path.join(run_dir, "logs")
+    # append a row with a NEW column -> earlier rows get '' back-filled
+    storage.save_statistics(
+        logs,
+        {"epoch": 2, "train_accuracy_mean": 0.9, "train_loss_mean": 0.3,
+         "val_accuracy_mean": 0.8, "val_loss_mean": 0.4, "brand_new_metric": 1.0},
+    )
+    run = analysis.load_run(run_dir)
+    assert run.summary[0]["brand_new_metric"] is None
+    assert run.summary[2]["brand_new_metric"] == 1.0
+    out = analysis.plot_learning_curves(run, str(tmp_path / "curves.png"))
+    assert out and os.path.exists(out)
+
+
 def test_load_run_and_collect(tmp_path):
     root = str(tmp_path)
     _make_run(root, "a.seed0", seed=0)

@@ -67,6 +67,20 @@ def test_unknown_key_rejected():
         load_config(None, ["no_such_key=1"])
 
 
+def test_ensemble_top_k_bounded_by_max_models_to_save():
+    """Regression (advisor r1): a K larger than the rotation window can never
+    be satisfied and would silently ensemble fewer members."""
+    with pytest.raises(ValueError, match="max_models_to_save"):
+        load_config(
+            None,
+            [
+                "test_ensemble_top_k=6",
+                "max_models_to_save=5",
+                "checkpoint_rotation=best_val",
+            ],
+        )
+
+
 def test_yaml_roundtrip(tmp_path):
     cfg = load_config(None, ["net=densenet-8", "seed=3"])
     path = tmp_path / "config.yaml"

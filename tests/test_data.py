@@ -186,6 +186,27 @@ def test_integrity_check_fails_fast(tmp_path):
     assert d.exists()  # and must NOT delete the data
 
 
+def test_pkl_variant_predicate_shared(tmp_path):
+    """Regression (advisor r1): integrity check and spec lookup must use the
+    same pkl predicate — a name merely *containing* 'pkl' is an image-folder
+    dataset for both, and a '*pkl' name is the 3-pickle layout for both."""
+    from howtotrainyourmamlpytorch_tpu.data.registry import get_dataset_spec, is_pkl_variant
+
+    assert is_pkl_variant("mini_imagenet_pkl")
+    assert not is_pkl_variant("pkl_omniglot_dataset")
+    # a 'pkl'-containing image-folder name is integrity-checked by image count
+    d = tmp_path / "pkl_omniglot_dataset"
+    (d / "a" / "b").mkdir(parents=True)
+    Image.fromarray(np.zeros((5, 5), np.uint8)).save(d / "a" / "b" / "img.png")
+    assert check_dataset_integrity(str(d), "pkl_omniglot_dataset") == 1
+    assert get_dataset_spec("pkl_omniglot_dataset").image_channels == 1
+    # the true pkl variant is counted by pickles and rejected by the spec
+    with pytest.raises(RuntimeError, match="pkl"):
+        check_dataset_integrity(str(d), "mini_imagenet_pkl")
+    with pytest.raises(ValueError, match="pkl"):
+        get_dataset_spec("mini_imagenet_pkl")
+
+
 def test_build_index_drops_unreadable_images(tmp_path):
     d = tmp_path / "ds"
     (d / "a" / "b").mkdir(parents=True)

@@ -302,6 +302,24 @@ def test_per_step_lslr_restores_upstream_semantics():
     assert np.isfinite(float(ev.loss))
 
 
+def test_per_step_lslr_with_rprop_inner_opt():
+    """Regression (advisor r1): rprop's init_state derives step_size from the
+    lr hparam; with lslr_per_step the lr leaves are (K,)-shaped and init must
+    see one step's values, not the K-vector (broadcast crash otherwise)."""
+    from howtotrainyourmamlpytorch_tpu.config import InnerOptimConfig
+
+    cfg = tiny_config(
+        lslr_per_step=True, inner_optim=InnerOptimConfig(kind="rprop", lr=0.1)
+    )
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    batch = _as_jnp(tiny_batch())
+    state, out = system.train_step(state, batch, epoch=0)
+    assert np.isfinite(float(out.loss))
+    K = cfg.number_of_training_steps_per_iter
+    assert np.asarray(state.inner_hparams["lr"]["w"]).shape == (K,)
+
+
 def test_vgg_meta_step_runs():
     """End-to-end meta-step through a real conv+BN backbone (small variant)."""
     cfg = tiny_config(num_classes_per_set=3)

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 from PIL import Image
 
-from howtotrainyourmamlpytorch_tpu.config import Config, DatasetConfig
+from howtotrainyourmamlpytorch_tpu.config import Config, DatasetConfig, ParallelConfig
 from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
 from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
 from howtotrainyourmamlpytorch_tpu.experiment.storage import load_statistics
@@ -38,6 +38,7 @@ def runner_config(toy_dataset, tmp_path, **overrides):
         num_samples_per_class=2,
         num_target_samples=2,
         batch_size=2,
+        parallel=ParallelConfig(dp=2),
         total_epochs=2,
         total_iter_per_epoch=3,
         num_evaluation_tasks=4,

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 from PIL import Image
 
-from howtotrainyourmamlpytorch_tpu.config import Config, DatasetConfig
+from howtotrainyourmamlpytorch_tpu.config import Config, DatasetConfig, ParallelConfig
 from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
 from howtotrainyourmamlpytorch_tpu.data import FewShotDataset, MetaLearningDataLoader
 from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
@@ -35,6 +35,7 @@ def toy_cfg(toy_dataset, **overrides):
         num_samples_per_class=1,
         num_target_samples=1,
         batch_size=2,
+        parallel=ParallelConfig(dp=2),
         total_epochs=5,
         total_iter_per_epoch=2,
         num_evaluation_tasks=2,
