@@ -60,8 +60,22 @@ class ParallelConfig:
 
     dp: int = -1  # -1: use all visible devices
     mp: int = 1
+    # Pipeline-parallel stage count — deliberate non-goal for this model
+    # family (SURVEY.md §2.11 PP row): the 4-conv backbones fit on one chip
+    # with room to spare, so splitting them into stages would only add
+    # bubble overhead. The field exists as the stage-partition hook; any
+    # value != 1 is rejected until a backbone warrants an implementation.
+    pp: int = 1
     # shard tasks of one meta-batch across dp; meta-grads psum over the mesh.
     shard_meta_batch: bool = True
+
+    def __post_init__(self):
+        if self.pp != 1:
+            raise ValueError(
+                f"pipeline parallelism (pp={self.pp}) is not implemented: the "
+                "reference's 4-conv backbones fit on a single chip (documented "
+                "non-goal, docs/DESIGN.md); use dp/mp"
+            )
 
 
 @dataclass

@@ -35,6 +35,26 @@ def _mean_std(values):
     return float(arr.mean()), float(arr.std())
 
 
+def _episode_stats(split: str, ep_losses, ep_accs) -> Dict[str, Any]:
+    """Eval statistics over *episodes* (one value per task), the unit the
+    published tables use. ``*_std`` is the per-episode standard deviation —
+    note this is spread across tasks, NOT the across-seeds std the reference's
+    notebook reports (VERDICT r2 weak #2: std over batch means understated
+    per-episode spread by ~sqrt(batch)). ``*_ci95`` is the 1.96*std/sqrt(n)
+    half-width for the mean, comparable across runs."""
+    loss_mean, loss_std = _mean_std(ep_losses)
+    acc_mean, acc_std = _mean_std(ep_accs)
+    n = int(np.size(ep_accs))
+    return {
+        f"{split}_loss_mean": loss_mean,
+        f"{split}_loss_std": loss_std,
+        f"{split}_accuracy_mean": acc_mean,
+        f"{split}_accuracy_std": acc_std,
+        f"{split}_accuracy_ci95": float(1.96 * acc_std / np.sqrt(max(n, 1))),
+        f"{split}_num_episodes": n,
+    }
+
+
 class ExperimentRunner:
     def __init__(
         self,
@@ -175,8 +195,9 @@ class ExperimentRunner:
             losses.append(out.loss)
             accs.append(out.accuracy)
             lr = out.learning_rate
-        losses = [float(x) for x in losses]
-        accs = [float(x) for x in accs]
+        # one bulk fetch instead of 2*iters scalar device_gets (each a
+        # round-trip when the chip sits behind a network tunnel)
+        losses, accs = jax.device_get((losses, accs))
         loss_mean, loss_std = _mean_std(losses)
         acc_mean, acc_std = _mean_std(accs)
         return {
@@ -196,19 +217,25 @@ class ExperimentRunner:
             if split == "val"
             else self.loader.test_batches(n_batches)
         )
-        losses, accs = [], []
+        ep_losses, ep_accs = [], []
         for batch in batches:
             out = self.system.eval_step(self.state, self._put(batch))
-            losses.append(out.loss)
-            accs.append(out.accuracy)
-        loss_mean, loss_std = _mean_std([float(x) for x in losses])
-        acc_mean, acc_std = _mean_std([float(x) for x in accs])
-        return {
-            f"{split}_loss_mean": loss_mean,
-            f"{split}_loss_std": loss_std,
-            f"{split}_accuracy_mean": acc_mean,
-            f"{split}_accuracy_std": acc_std,
-        }
+            ep_losses.append(out.per_task_losses)
+            ep_accs.append(out.per_task_accuracies)
+        if self._multihost:
+            # the [B_global] per-task arrays are dp-sharded across processes
+            # (not fully addressable) — gather the global view on every host
+            # before leaving device land
+            from jax.experimental import multihost_utils
+
+            ep_losses, ep_accs = multihost_utils.process_allgather(
+                (ep_losses, ep_accs), tiled=True
+            )
+        else:
+            # one bulk fetch instead of 2*n_batches scalar device_gets (each
+            # a round-trip when the chip sits behind a network tunnel)
+            ep_losses, ep_accs = jax.device_get((ep_losses, ep_accs))
+        return _episode_stats(split, np.concatenate(ep_losses), np.concatenate(ep_accs))
 
     def write_inner_opt_stats(self) -> None:
         """One row per epoch of the learned per-tensor hyperparams (reference
@@ -306,19 +333,16 @@ class ExperimentRunner:
             for epoch in ranked:
                 state, _ = ckpt.load_checkpoint(self.saved_models_dir, epoch, template)
                 member_probs.append(self._collect_test_probs(state, batches))
-            accs, losses = [], []
+            ep_accs, ep_losses = [], []
             for b, y in enumerate(labels):
                 mean_probs = np.mean([m[b] for m in member_probs], axis=0)
-                accs.append(float((mean_probs.argmax(-1) == y).mean()))
+                # per-episode ([B]-shaped) accuracy/NLL of the averaged
+                # ensemble probabilities
+                ep_accs.append((mean_probs.argmax(-1) == y).mean(axis=-1))
                 true_p = np.take_along_axis(mean_probs, y[..., None], axis=-1)
-                losses.append(float(-np.log(np.maximum(true_p, 1e-12)).mean()))
-            acc_mean, acc_std = _mean_std(accs)
-            loss_mean, loss_std = _mean_std(losses)
+                ep_losses.append(-np.log(np.maximum(true_p, 1e-12)).mean(axis=(-2, -1)))
             stats = {
-                "test_loss_mean": loss_mean,
-                "test_loss_std": loss_std,
-                "test_accuracy_mean": acc_mean,
-                "test_accuracy_std": acc_std,
+                **_episode_stats("test", np.concatenate(ep_losses), np.concatenate(ep_accs)),
                 "test_ensemble_size": len(ranked),
                 "test_ensemble_epochs": " ".join(str(e) for e in ranked),
             }

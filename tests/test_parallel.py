@@ -83,3 +83,14 @@ def test_explicit_shard_map_psum_meta_grad():
     )(w, xs)
     g_global = jax.grad(loss)(w, xs)
     np.testing.assert_allclose(np.asarray(g_sharded), np.asarray(g_global), rtol=1e-5)
+
+
+def test_pp_hook_rejects_multi_stage():
+    """SURVEY §2.11 PP row: the stage-partition hook exists in the mesh
+    config and any pp != 1 is rejected with the documented non-goal."""
+    import pytest
+    from howtotrainyourmamlpytorch_tpu.config import ParallelConfig
+
+    assert ParallelConfig().pp == 1
+    with pytest.raises(ValueError, match="pipeline parallelism"):
+        ParallelConfig(pp=2)
