@@ -156,29 +156,32 @@ def main():
     elapsed = time.perf_counter() - start
     steps_per_sec = n_iters / elapsed
 
-    # --- MFU: model FLOPs per meta-step (XLA cost analysis of the exact
-    # compiled program) / chip dense-bf16 peak. ---
-    mfu = flops_per_step = None
+    # --- FLOPs per meta-step #1: XLA cost analysis of the exact compiled
+    # program (may be unimplemented by the PJRT plugin -> None, never a crash).
+    flops_hlo = None
     try:
         # same program variant the timed loop selected for epoch=0
         lowered = system._compiled_train_step(
             system.use_second_order(0), system.msl_active(0)
         ).lower(state, batch)
-        try:
-            ca = lowered.cost_analysis()  # from HLO, no backend compile
-        except Exception:
-            ca = lowered.compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        flops_per_step = float(ca.get("flops", 0.0)) or None
-        peak = _peak_flops(device_kind)
-        if flops_per_step and peak:
-            mfu = round(flops_per_step * steps_per_sec / peak, 5)
+        for get in (lowered.cost_analysis, lambda: lowered.compile().cost_analysis()):
+            try:
+                ca = get()
+            except Exception:
+                continue
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            if ca is not None and float(ca.get("flops", 0.0) or 0.0) > 0:
+                flops_hlo = float(ca["flops"])
+                break
     except Exception as e:
         print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)
 
-    # --- device-time breakdown from a short jax.profiler trace ---
+    # --- device-time breakdown + measured FLOPs from a short jax.profiler
+    # trace (per-op flops + hlo_category + chip peak are in the xplane). ---
     breakdown = None
+    flops_measured = None
+    trace_peak = None
     try:
         from howtotrainyourmamlpytorch_tpu.utils.profiling import device_time_breakdown
 
@@ -194,9 +197,24 @@ def main():
         breakdown = device_time_breakdown(trace_dir)
         if breakdown is not None:
             breakdown["wall_ms_per_step"] = round(1e3 * prof_wall / n_prof, 3)
-            breakdown.pop("top_ops", None)  # keep the JSON line short
+            if breakdown.get("flops_total"):
+                flops_measured = breakdown["flops_total"] / n_prof
+            trace_peak = breakdown.pop("peak_flops_per_sec", None)
+            # keep the JSON line short
+            breakdown.pop("top_ops", None)
+            breakdown.pop("flops_total", None)
+            breakdown.pop("model_flops_total", None)
     except Exception as e:
         print(f"bench: profile breakdown unavailable: {e}", file=sys.stderr)
+
+    # --- MFU = FLOPs/step x steps/s / chip peak. Measured per-op trace FLOPs
+    # preferred (it is what actually executed); HLO cost analysis as backup;
+    # chip peak from the trace's own plane stat, table as fallback. ---
+    flops_per_step = flops_measured or flops_hlo
+    peak = trace_peak or _peak_flops(device_kind)
+    mfu = None
+    if flops_per_step and peak:
+        mfu = round(flops_per_step * steps_per_sec / peak, 5)
 
     print(
         json.dumps(
@@ -207,6 +225,10 @@ def main():
                 "vs_baseline": round(steps_per_sec / REFERENCE_STEPS_PER_SEC, 3),
                 "platform": f"{platform}:{device_kind}",
                 "flops_per_step": flops_per_step,
+                "flops_source": (
+                    "trace" if flops_measured else ("hlo" if flops_hlo else None)
+                ),
+                "peak_flops_per_sec": peak,
                 "mfu": mfu,
                 "breakdown": breakdown,
             }

@@ -24,7 +24,12 @@ import numpy as np
 from ..config import Config, save_config
 from ..core import MAMLSystem, TrainState
 from ..data import FewShotDataset, MetaLearningDataLoader
-from ..parallel import batch_sharding, global_batch_from_local, make_mesh, replicate
+from ..parallel import (
+    batch_sharding,
+    global_batch_from_local,
+    make_mesh,
+    shard_train_state,
+)
 from ..utils.trees import named_leaves
 from . import checkpoint as ckpt
 from . import storage
@@ -130,7 +135,10 @@ class ExperimentRunner:
                     "to deliberately train on a single device"
                 )
             self.mesh = mesh
-            self.state = replicate(self.state, self.mesh)
+            # dp: replicated train state; dp x mp: tensor-parallel shardings
+            # (dense-head kernel column-parallel over mp; convs replicated —
+            # rationale in parallel/mesh.py::_param_spec)
+            self.state = shard_train_state(self.state, self.mesh)
             self._batch_sharding = batch_sharding(self.mesh)
 
         # multi-host SPMD: each host materializes only its slice of the global
@@ -143,16 +151,10 @@ class ExperimentRunner:
                 "multi-host run but no usable device mesh: enable "
                 "parallel.shard_meta_batch and make batch_size divisible by dp"
             )
-        if self._multihost and cfg.test_ensemble_top_k > 1:
-            # the ensemble path np.asarray's dp-sharded global logits (not
-            # fully addressable across hosts) and scores host-local labels
-            # against global logits — refuse at construction, not after a
-            # multi-day training run, until it gathers via
-            # multihost_utils.process_allgather.
-            raise NotImplementedError(
-                "test_ensemble_top_k > 1 is not supported on multi-host runs; "
-                "set test_ensemble_top_k=1 (single-model test evaluation)"
-            )
+        # multi-host test ensembling works: per-task logits are gathered to
+        # every host via multihost_utils.process_allgather (_gather_array)
+        # and host-local label slices are tiled into the global order
+        # (_gather_host_local) before scoring — see evaluate_test.
         host_shard = (
             (jax.process_index(), jax.process_count()) if self._multihost else None
         )
@@ -226,11 +228,8 @@ class ExperimentRunner:
             # the [B_global] per-task arrays are dp-sharded across processes
             # (not fully addressable) — gather the global view on every host
             # before leaving device land
-            from jax.experimental import multihost_utils
-
-            ep_losses, ep_accs = multihost_utils.process_allgather(
-                (ep_losses, ep_accs), tiled=True
-            )
+            ep_losses = [self._gather_array(x) for x in ep_losses]
+            ep_accs = [self._gather_array(x) for x in ep_accs]
         else:
             # one bulk fetch instead of 2*n_batches scalar device_gets (each
             # a round-trip when the chip sits behind a network tunnel)
@@ -293,6 +292,26 @@ class ExperimentRunner:
 
     # ------------------------------------------------------------------
 
+    def _gather_array(self, x) -> np.ndarray:
+        """Device array -> host numpy of the *global* value. On multi-host
+        runs the eval outputs are dp-sharded global jax.Arrays (not fully
+        addressable), so fetch via an all-gather every host participates in."""
+        if self._multihost:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x))
+        return np.asarray(x)
+
+    def _gather_host_local(self, x: np.ndarray) -> np.ndarray:
+        """Host-local numpy slice -> global array, concatenated in process
+        order along axis 0 — the same order ``global_batch_from_local`` lays
+        the dp-sharded batch out in (host p owns rows [p*per_host, ...))."""
+        if self._multihost:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(np.asarray(x), tiled=True)
+        return np.asarray(x)
+
     def _collect_test_probs(self, state: TrainState, batches):
         """Per-batch softmax target probabilities for pre-assembled test
         batches (the test stream is fixed-seed, so every ensemble member sees
@@ -300,7 +319,7 @@ class ExperimentRunner:
         probs = []
         for batch in batches:
             out = self.system.eval_step(state, self._put(batch))
-            probs.append(np.asarray(jax.nn.softmax(out.per_task_target_logits, axis=-1)))
+            probs.append(self._gather_array(jax.nn.softmax(out.per_task_target_logits, axis=-1)))
         return probs
 
     def evaluate_test(self) -> Dict[str, Any]:
@@ -327,7 +346,15 @@ class ExperimentRunner:
         if len(ranked) > 1:
             n_batches = max(self.cfg.num_evaluation_tasks // self.loader.batch_size, 1)
             batches = list(self.loader.test_batches(n_batches))  # assembled once
-            labels = [b["y_target"].reshape(b["y_target"].shape[0], -1) for b in batches]
+            # on multi-host runs each loader yields only this host's slice of
+            # the global batch; tile the label slices into global order to
+            # score against the gathered global probabilities
+            labels = [
+                self._gather_host_local(
+                    b["y_target"].reshape(b["y_target"].shape[0], -1)
+                )
+                for b in batches
+            ]
             template = jax.device_get(self.state)
             member_probs = []
             for epoch in ranked:

@@ -9,4 +9,6 @@ from .mesh import (  # noqa: F401
     replicate,
     replicated,
     shard_batch,
+    shard_train_state,
+    train_state_shardings,
 )

@@ -57,6 +57,68 @@ def replicate(tree, mesh: Mesh):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
 
 
+def _param_spec(shape, mp: int) -> P:
+    """Tensor-parallel spec for one parameter leaf: *dense (2-D) kernels*
+    shard their output-features axis (column-parallel ``P(None, 'mp')``)
+    when it divides ``mp``; everything else is replicated.
+
+    Why exactly this layout (verified on the 8-device CPU mesh):
+    - conv-kernel channel sharding is rejected by XLA's SPMD partitioner for
+      this program family — the vmap over tasks becomes a batch-grouped
+      convolution and ``spmd_partitioner`` hard-crashes in
+      ``convolution_handler.cc`` ("Check failed: new_input_batch_size %
+      new_output_batch_size == 0");
+    - row-parallel (input-axis) dense sharding is unsafe whenever the conv
+      stack pools down to 1x1 spatial (the 28x28 4-stage default): the
+      flatten reshape is then channel-aligned, the sharding propagates back
+      into the conv output channels, and the same partitioner crash fires;
+    - column-parallel keeps all activations replicated until the logits
+      (dL/dx contracts over the sharded class axis into a psum), so the conv
+      stack never sees a sharded operand.
+    The conv kernels here are <=150KB, so TP buys nothing on them anyway;
+    the dense head is where TP matters as heads widen."""
+    if len(shape) == 2 and shape[1] >= mp and shape[1] % mp == 0:
+        return P(None, MODEL_AXIS)
+    return P()
+
+
+def train_state_shardings(state, mesh: Mesh):
+    """NamedSharding pytree for a ``TrainState``: model parameters and their
+    optimizer-moment mirrors are tensor-parallel over ``mp`` (SURVEY.md §2.11
+    TP row — pjit param sharding specs on conv/linear weights); everything
+    else (BN stats, per-tensor inner hparams, scalars) is replicated. With
+    ``mp == 1`` every leaf is replicated — identical to :func:`replicate`."""
+    mp = mesh.shape.get(MODEL_AXIS, 1)
+    rep = NamedSharding(mesh, P())
+    if mp == 1:
+        return jax.tree.map(lambda _: rep, state)
+
+    def param_sharding(leaf):
+        return NamedSharding(mesh, _param_spec(tuple(leaf.shape), mp))
+
+    def opt_spec(path, leaf):
+        # the outer optimizer's moment trees (adam mu/nu) mirror the
+        # trainables dict {'params': ..., 'hparams': ...}: shard the 'params'
+        # mirrors exactly like the params; inner hparams are per-tensor
+        # scalars — nothing to shard
+        keys = {getattr(k, "key", None) for k in path}
+        return param_sharding(leaf) if "params" in keys else rep
+
+    return type(state)(
+        params=jax.tree.map(param_sharding, state.params),
+        bn_state=jax.tree.map(lambda _: rep, state.bn_state),
+        inner_hparams=jax.tree.map(lambda _: rep, state.inner_hparams),
+        opt_state=jax.tree_util.tree_map_with_path(opt_spec, state.opt_state),
+        step=rep,
+    )
+
+
+def shard_train_state(state, mesh: Mesh):
+    """Place a TrainState pytree onto the mesh with tensor-parallel parameter
+    shardings (replicates everything when ``mp == 1``)."""
+    return jax.tree.map(jax.device_put, state, train_state_shardings(state, mesh))
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

@@ -1,73 +1,96 @@
-"""Device-time breakdown from a ``jax.profiler`` trace.
+"""Device-time breakdown + measured FLOPs from a ``jax.profiler`` trace.
 
 The reference has no profiling at all (SURVEY.md §5.1); here a trace window is
 first-class (runner ``profile_dir``) and this module turns the written
-``*.xplane.pb`` into a 3-line device-time breakdown (compute / data-movement /
-other) without TensorBoard: the tensorboard profile plugin is incompatible
-with the installed TF in this image, so the xplane proto is parsed directly
-via ``tensorflow.tsl`` under the pure-python protobuf implementation.
+``*.xplane.pb`` into a device-time breakdown (compute / data-movement / other)
+and a *measured* FLOPs count without TensorBoard: the tensorboard profile
+plugin is incompatible with the installed TF in this image, so the xplane
+proto is parsed directly via ``tensorflow.tsl`` under the pure-python
+protobuf implementation.
+
+Schema notes (verified against a real TPU v5e trace of the bench step):
+- the device plane is ``/device:TPU:N``; its ``XLA Ops`` line carries one
+  event per executed HLO op (the ``Steps`` / ``XLA Modules`` lines span the
+  same busy time hierarchically — summing all lines would double-count);
+- per-op classification/FLOPs live on the op's *event metadata* stats
+  (``hlo_category``, ``flops``, ``model_flops``), not on the events;
+- chip peaks are plane-level stats (``peak_teraflops_per_second``).
 """
 
 import glob
 import os
 from typing import Any, Dict, Optional
 
-# Op-name prefixes that are data movement (HBM<->HBM/infeed DMA), not MXU/VPU
-# compute. copy/slice dominate when layouts force relayout between ops.
-_DMA_PREFIXES = (
+# hlo_category substrings -> bucket. Data movement is checked FIRST: e.g.
+# 'all-reduce' must land in dma (communication) before the 'reduce' compute
+# match. Categories observed on real v5e traces include 'loop fusion',
+# 'convolution fusion', 'select-and-scatter', 'reduce-window',
+# 'data formatting', 'copy-start/done', 'async-start/done', 'reverse'.
+_DMA_SUBSTRINGS = (
+    "data formatting",
     "copy",
-    "slice",
-    "dynamic-slice",
-    "dynamic-update-slice",
+    "async",
+    "reverse",
+    "pad",
+    "broadcast",
     "transpose",
     "reshape",
     "bitcast",
     "concatenate",
+    "slice",
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "reduce-scatter",
+    "collective",
+    "permute",
     "infeed",
     "outfeed",
-    "all-to-all",
+    "send",
+    "recv",
+    "host",
+    "tuple",
 )
-_COMPUTE_PREFIXES = (
+_COMPUTE_SUBSTRINGS = (
     "fusion",
     "convolution",
     "dot",
-    "loop",
-    "scatter",
+    "reduce",  # reduce, reduce-window
+    "scatter",  # scatter, select-and-scatter
     "gather",
-    "reduce",
+    "elementwise",
     "rng",
-    "select",
+    "sort",
     "while",
-    "custom-call",
+    "conditional",
+    "call",  # call, custom-call (pallas kernels surface as custom-call)
+    "iota",
+    "cholesky",
+    "triangular",
+    "fft",
 )
 
 
-def _categorize(op_name: str) -> str:
-    name = op_name.lower()
-    for p in _DMA_PREFIXES:
-        if name.startswith(p):
+def _categorize(category: Optional[str], op_name: str) -> str:
+    """Bucket one op. Prefer the profiler's own ``hlo_category``; fall back to
+    the HLO op name (full-text like ``%reduce_window.156 = bf16[...] ...`` on
+    real traces — extract the leading op token) when the stat is absent."""
+    text = (category or "").lower()
+    if not text:
+        # '%reduce_window.156 = ...' -> 'reduce-window'; 'fusion.12' -> 'fusion'
+        tok = op_name.lstrip("%").split(" ")[0].split("=")[0]
+        text = tok.rstrip("0123456789").rstrip(".").replace("_", "-").lower()
+    for sub in _DMA_SUBSTRINGS:
+        if sub in text:
             return "dma"
-    for p in _COMPUTE_PREFIXES:
-        if name.startswith(p):
+    for sub in _COMPUTE_SUBSTRINGS:
+        if sub in text:
             return "compute"
     return "other"
 
 
-def device_time_breakdown(trace_dir: str) -> Optional[Dict[str, Any]]:
-    """Aggregate per-op device busy time from the newest xplane in trace_dir.
-
-    Returns ``{"compute_frac", "dma_frac", "other_frac", "device_busy_ms",
-    "top_ops"}`` over the whole trace window, or None when no xplane / no
-    device plane is found. Fractions are of device *busy* time (events on the
-    device plane); wall time per step is the caller's to measure.
-    """
+def _load_xspace(path: str):
     os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
-    paths = sorted(
-        glob.glob(os.path.join(trace_dir, "plugins", "profile", "*", "*.xplane.pb")),
-        key=os.path.getmtime,
-    )
-    if not paths:
-        return None
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2  # type: ignore
     except Exception:
@@ -75,47 +98,98 @@ def device_time_breakdown(trace_dir: str) -> Optional[Dict[str, Any]]:
             from tsl.profiler.protobuf import xplane_pb2  # type: ignore
         except Exception:
             return None
-
     xspace = xplane_pb2.XSpace()
-    with open(paths[-1], "rb") as f:
+    with open(path, "rb") as f:
         xspace.ParseFromString(f.read())
+    return xspace
 
-    device_planes = [
-        p
-        for p in xspace.planes
-        if p.name.startswith("/device:TPU:") or p.name.startswith("/device:CPU:0")
-    ]
-    # prefer TPU planes when both exist
-    tpu = [p for p in device_planes if "TPU" in p.name]
-    planes = tpu or device_planes
-    if not planes:
+
+def _stat_value(stat):
+    for field in ("double_value", "int64_value", "uint64_value"):
+        v = getattr(stat, field)
+        if v:
+            return v
+    return stat.str_value or None
+
+
+def breakdown_from_xplane(path: str) -> Optional[Dict[str, Any]]:
+    """Aggregate the op-level line of the newest device plane in one xplane
+    file. Returns None when the file has no device plane (e.g. CPU-only
+    traces, whose ``/host:CPU`` plane carries python spans, not HLO ops)."""
+    xspace = _load_xspace(path)
+    if xspace is None:
+        return None
+    device_planes = [p for p in xspace.planes if p.name.startswith("/device:TPU:")]
+    if not device_planes:
         return None
 
     per_op_ps: Dict[str, int] = {}
-    for plane in planes:
+    cat_ps = {"compute": 0, "dma": 0, "other": 0}
+    flops_total = 0
+    model_flops_total = 0
+    peak_flops = None
+    n_events = 0
+    for plane in device_planes:
+        sm = plane.stat_metadata
         meta = plane.event_metadata
-        # device planes carry hierarchical lines ('XLA Modules', 'Steps')
-        # whose events span the same device time as the op-level 'XLA Ops'
-        # line — summing them all would double/triple-count busy time
-        op_lines = [l for l in plane.lines if l.name == "XLA Ops"] or list(plane.lines)
+        for stat in plane.stats:
+            if sm[stat.metadata_id].name == "peak_teraflops_per_second":
+                v = _stat_value(stat)
+                if v:
+                    peak_flops = float(v) * 1e12
+        # the op-level line only; 'Steps'/'XLA Modules' span the same device
+        # time hierarchically and 'Async XLA Ops' overlap the sync timeline
+        op_lines = [l for l in plane.lines if l.name == "XLA Ops"]
         for line in op_lines:
             for event in line.events:
-                name = meta[event.metadata_id].name if event.metadata_id in meta else "?"
+                m = meta.get(event.metadata_id)
+                name = (m.display_name or m.name) if m is not None else "?"
+                category = None
+                if m is not None:
+                    for stat in m.stats:
+                        stat_name = sm[stat.metadata_id].name
+                        if stat_name == "hlo_category":
+                            category = stat.str_value
+                        elif stat_name == "flops":
+                            flops_total += stat.int64_value or stat.uint64_value
+                        elif stat_name == "model_flops":
+                            model_flops_total += stat.int64_value or stat.uint64_value
+                n_events += 1
+                bucket = _categorize(category, name)
+                cat_ps[bucket] += event.duration_ps
                 per_op_ps[name] = per_op_ps.get(name, 0) + event.duration_ps
 
-    total_ps = sum(per_op_ps.values())
+    total_ps = sum(cat_ps.values())
     if total_ps == 0:
         return None
-    cat_ps = {"compute": 0, "dma": 0, "other": 0}
-    for name, ps in per_op_ps.items():
-        cat_ps[_categorize(name)] += ps
     top = sorted(per_op_ps.items(), key=lambda kv: -kv[1])[:8]
-    return {
+    result: Dict[str, Any] = {
         "compute_frac": round(cat_ps["compute"] / total_ps, 4),
         "dma_frac": round(cat_ps["dma"] / total_ps, 4),
         "other_frac": round(cat_ps["other"] / total_ps, 4),
         "device_busy_ms": round(total_ps / 1e9, 3),
-        "top_ops": [
-            {"op": name, "ms": round(ps / 1e9, 3)} for name, ps in top
-        ],
+        "n_events": n_events,
+        "flops_total": flops_total or None,
+        "model_flops_total": model_flops_total or None,
+        "peak_flops_per_sec": peak_flops,
+        "top_ops": [{"op": name[:80], "ms": round(ps / 1e9, 3)} for name, ps in top],
     }
+    if cat_ps["other"] == total_ps and n_events > 0:
+        # nothing matched either the category stat or the name tables: the
+        # fractions are meaningless — say so instead of reporting 0/0/1 as if
+        # it were a measurement (VERDICT r2 item 2)
+        result["classification_failed"] = True
+    return result
+
+
+def device_time_breakdown(trace_dir: str) -> Optional[Dict[str, Any]]:
+    """Breakdown of the newest xplane under ``trace_dir`` (the layout
+    ``jax.profiler.start_trace`` writes: ``plugins/profile/<ts>/*.xplane.pb``),
+    or None when no xplane / no device plane is found."""
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "plugins", "profile", "*", "*.xplane.pb")),
+        key=os.path.getmtime,
+    )
+    if not paths:
+        return None
+    return breakdown_from_xplane(paths[-1])

@@ -1,27 +1,12 @@
 #!/bin/bash
 # Round-3 accuracy matrix (VERDICT r2 item 1): the reference's published
 # Omniglot configs (BASELINE.md / nbs cells 9-11), full 150-epoch budget,
-# seed 0, run serially on the attached TPU chip.
+# seed 0, serial on the attached TPU chip via the watchdogged harness.
 # Reference anchors: vgg+SGD 5w1s 99.62+-0.08, 5w5s 99.86+-0.02,
 # 20w1s 97.21+-0.11, 20w5s 99.13+-0.13; resnet-4+SGD 5w1s 99.91+-0.05.
-set -u
-cd /root/repo
-COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
- dataset.path=/root/reference/datasets/omniglot_dataset \
- index_cache_dir=/tmp/omniglot_idx load_into_memory=true \
- total_epochs=150 remat_inner_steps=false"
-
-run () {
-  name=$1; shift
-  echo "=== $(date -u +%H:%M:%S) start $name" >> exps/sweep_r3.log
-  python train_maml_system.py $COMMON experiment_name="$name" "$@" \
-    >> "exps/${name}.out" 2>&1
-  echo "=== $(date -u +%H:%M:%S) done $name rc=$?" >> exps/sweep_r3.log
-}
-
-run omniglot.5.1.vgg.gd.s0      num_classes_per_set=5  num_samples_per_class=1 net=vgg
-run omniglot.20.1.vgg.gd.s0     num_classes_per_set=20 num_samples_per_class=1 net=vgg
-run omniglot.5.5.vgg.gd.s0      num_classes_per_set=5  num_samples_per_class=5 net=vgg
-run omniglot.20.5.vgg.gd.s0     num_classes_per_set=20 num_samples_per_class=5 net=vgg
-run omniglot.5.1.resnet-4.gd.s0 num_classes_per_set=5  num_samples_per_class=1 net=resnet-4
-echo "=== $(date -u +%H:%M:%S) ALL DONE" >> exps/sweep_r3.log
+exec "$(dirname "$0")/sweep.sh" \
+  "omniglot.5.1.vgg.gd.s0      num_classes_per_set=5  num_samples_per_class=1 net=vgg" \
+  "omniglot.20.1.vgg.gd.s0     num_classes_per_set=20 num_samples_per_class=1 net=vgg" \
+  "omniglot.5.5.vgg.gd.s0      num_classes_per_set=5  num_samples_per_class=5 net=vgg" \
+  "omniglot.20.5.vgg.gd.s0     num_classes_per_set=20 num_samples_per_class=5 net=vgg" \
+  "omniglot.5.1.resnet-4.gd.s0 num_classes_per_set=5  num_samples_per_class=1 net=resnet-4"

@@ -1,0 +1,54 @@
+#!/bin/bash
+# Watchdogged serial sweep harness for real-chip accuracy runs.
+#
+# Usage: scripts/sweep.sh "<name> <override...>" ["<name> <override...>" ...]
+# Each job is one train_maml_system.py run named <name> with extra overrides.
+#
+# The chip sits behind a network tunnel that occasionally wedges mid-run
+# (device call never returns; process sleeps forever). Every epoch writes an
+# atomic checkpoint and the episode stream is a pure function of (seed, iter),
+# so the watchdog kills a run whose log goes stale and restarts it — resume
+# is exact (continue_from_epoch=latest is the default). python -u: the log
+# mtime is the liveness signal, so stdout must not sit in a block buffer.
+set -u
+cd /root/repo
+COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
+ dataset.path=/root/reference/datasets/omniglot_dataset \
+ index_cache_dir=/tmp/omniglot_idx load_into_memory=true \
+ total_epochs=150 remat_inner_steps=false"
+STALL_SECS=${STALL_SECS:-240}   # epochs are 6-90s; 240s of silence = wedged
+MAX_RESTARTS=${MAX_RESTARTS:-8}
+
+run () {
+  name=$1; shift
+  out="exps/${name}.out"
+  for attempt in $(seq 0 $MAX_RESTARTS); do
+    echo "=== $(date -u +%H:%M:%S) start $name attempt=$attempt" >> exps/sweep_r3.log
+    python -u train_maml_system.py $COMMON experiment_name="$name" "$@" \
+      >> "$out" 2>&1 &
+    pid=$!
+    while kill -0 $pid 2>/dev/null; do
+      sleep 30
+      age=$(( $(date +%s) - $(stat -c %Y "$out") ))
+      if [ "$age" -gt "$STALL_SECS" ]; then
+        echo "=== $(date -u +%H:%M:%S) $name STALLED (log ${age}s old), killing $pid" >> exps/sweep_r3.log
+        kill $pid 2>/dev/null; sleep 5; kill -9 $pid 2>/dev/null
+        break
+      fi
+    done
+    wait $pid; rc=$?
+    echo "=== $(date -u +%H:%M:%S) $name attempt=$attempt rc=$rc" >> exps/sweep_r3.log
+    [ $rc -eq 0 ] && return 0
+    sleep 10   # let the tunnel lease clear before reconnecting
+  done
+  echo "=== $(date -u +%H:%M:%S) $name FAILED after $MAX_RESTARTS restarts" >> exps/sweep_r3.log
+  return 1
+}
+
+TOTAL=$#
+OK=0
+for job in "$@"; do
+  set -- $job
+  run "$@" && OK=$((OK + 1))
+done
+echo "=== $(date -u +%H:%M:%S) SWEEP DONE: $OK/$TOTAL jobs" >> exps/sweep_r3.log

@@ -14,6 +14,7 @@ from howtotrainyourmamlpytorch_tpu.config import Config, DatasetConfig, Parallel
 from howtotrainyourmamlpytorch_tpu.data import FewShotDataset, MetaLearningDataLoader
 from howtotrainyourmamlpytorch_tpu.data.index import check_dataset_integrity
 from howtotrainyourmamlpytorch_tpu.parallel import mesh as mesh_mod
+from tests.test_runner import toy_dataset  # noqa: F401  (pytest fixture import)
 
 
 @pytest.fixture(scope="module")
@@ -120,3 +121,49 @@ def test_pkl_dataset_integrity(tmp_path):
 
     with pytest.raises(ValueError, match="pkl"):
         get_dataset_spec("mini_imagenet_pkl")
+
+
+def test_multihost_ensemble_gathers_via_process_allgather(
+    toy_dataset, tmp_path, monkeypatch
+):
+    """Top-K test ensembling on a (mocked) 2-process run: per-task logits are
+    fetched with ``multihost_utils.process_allgather`` (never a bare
+    ``np.asarray`` of a non-addressable array), host-local label slices go
+    through the tiled gather, and the gathered path reproduces the
+    single-host numbers (VERDICT r2 item 5)."""
+    from jax.experimental import multihost_utils
+
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
+    from tests.test_runner import runner_config, small_system
+
+    cfg = runner_config(
+        toy_dataset, tmp_path,
+        experiment_name="toy_mh_ensemble",
+        checkpoint_rotation="best_val",
+        test_ensemble_top_k=2,
+    )
+    runner = ExperimentRunner(cfg, system=small_system(cfg))
+    runner.run_experiment()
+
+    single_host = runner.evaluate_test()
+
+    calls = {"plain": 0, "tiled": 0}
+    real_asarray = np.asarray
+
+    def fake_allgather(x, tiled=False):
+        # single-process stand-in for the 2-host collective: the local value
+        # already IS the global value here; what matters is that the gather
+        # is the only route to host memory on the multihost path
+        calls["tiled" if tiled else "plain"] += 1
+        return real_asarray(x)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+    runner._multihost = True
+    gathered = runner.evaluate_test()
+
+    n_batches = max(cfg.num_evaluation_tasks // runner.loader.batch_size, 1)
+    assert calls["tiled"] == n_batches  # one per batch of labels
+    assert calls["plain"] == n_batches * gathered["test_ensemble_size"]
+    for key in ("test_accuracy_mean", "test_loss_mean", "test_accuracy_std"):
+        assert gathered[key] == pytest.approx(single_host[key])
+    assert gathered["test_ensemble_size"] == 2

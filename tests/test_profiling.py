@@ -1,0 +1,90 @@
+"""Trace-breakdown tests against a REAL TPU v5e xplane fixture.
+
+``tests/fixtures/tpu_v5e_bench.xplane.pb`` is the first 2000 op events of an
+actual v5e trace of the bench meta-step (captured by ``bench.py`` on the
+attached chip; pruned to category/flops stats). Round-2's breakdown bug —
+every real-chip op falling into "other" because classification matched
+synthetic op names only — is exactly what a CPU-only test cannot catch
+(VERDICT r2 item 2), hence this fixture.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.utils.profiling import (
+    _categorize,
+    breakdown_from_xplane,
+    device_time_breakdown,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "tpu_v5e_bench.xplane.pb")
+
+
+def test_real_v5e_trace_classifies():
+    b = breakdown_from_xplane(FIXTURE)
+    assert b is not None
+    assert "classification_failed" not in b
+    # the bench step is compute-dominated on the real chip (fusions, convs,
+    # reduce-window); data movement is a real but minor fraction
+    assert b["compute_frac"] > 0.5
+    assert b["dma_frac"] > 0.0
+    assert b["other_frac"] < 0.2
+    assert abs(b["compute_frac"] + b["dma_frac"] + b["other_frac"] - 1.0) < 0.01
+    # measured per-op FLOPs and the chip's own peak ride in the trace
+    assert b["flops_total"] > 1e11
+    assert b["model_flops_total"] > 1e11
+    assert b["peak_flops_per_sec"] == pytest.approx(202.7e12)
+    assert b["device_busy_ms"] > 1.0
+
+
+def test_trace_dir_discovery(tmp_path):
+    d = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    d.mkdir(parents=True)
+    shutil.copy(FIXTURE, d / "vm.xplane.pb")
+    b = device_time_breakdown(str(tmp_path))
+    assert b is not None and b["compute_frac"] > 0.5
+    assert device_time_breakdown(str(tmp_path / "empty")) is None
+
+
+def test_category_mapping_real_v5e_categories():
+    # hlo_category values observed on the real v5e trace
+    assert _categorize("loop fusion", "") == "compute"
+    assert _categorize("convolution fusion", "") == "compute"
+    assert _categorize("select-and-scatter", "") == "compute"
+    assert _categorize("reduce-window", "") == "compute"
+    assert _categorize("non-fusion elementwise", "") == "compute"
+    assert _categorize("data formatting", "") == "dma"
+    assert _categorize("copy-done", "") == "dma"
+    assert _categorize("async-start", "") == "dma"
+    assert _categorize("reverse", "") == "dma"
+    # communication must not hit the 'reduce' compute match
+    assert _categorize("all-reduce", "") == "dma"
+    # fallbacks from full-text HLO op names (no category stat)
+    assert _categorize(None, "%reduce_window.156 = bf16[8,100]{...}") == "compute"
+    assert _categorize(None, "%copy.3 = f32[5]{0} copy(...)") == "dma"
+    assert _categorize(None, "fusion.12") == "compute"
+    assert _categorize(None, "frobnicate.9") == "other"
+
+
+def test_all_unknown_flags_classification_failure(tmp_path):
+    """If nothing classifies, the breakdown must say so instead of silently
+    reporting 0/0/1 as a measurement (the round-2 failure mode)."""
+    xplane_pb2 = pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
+    xs = xplane_pb2.XSpace()
+    plane = xs.planes.add()
+    plane.name = "/device:TPU:0"
+    line = plane.lines.add()
+    line.name = "XLA Ops"
+    meta = plane.event_metadata[1]
+    meta.id = 1
+    meta.display_name = "frobnicate.1"  # matches no table, no category stat
+    ev = line.events.add()
+    ev.metadata_id = 1
+    ev.duration_ps = 1_000_000
+    path = tmp_path / "weird.xplane.pb"
+    path.write_bytes(xs.SerializeToString())
+    b = breakdown_from_xplane(str(path))
+    assert b["other_frac"] == 1.0
+    assert b["classification_failed"] is True
