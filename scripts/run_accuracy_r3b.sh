@@ -1,43 +1,8 @@
 #!/bin/bash
-# Round-3 accuracy matrix, part B: remaining configs after 5w1s completed
-# (99.57% test) and 20w1s was parked for diagnosis (learned-lr runaway).
-# Same watchdog-against-tunnel-wedge structure as run_accuracy_r3.sh.
-set -u
-cd /root/repo
-COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
- dataset.path=/root/reference/datasets/omniglot_dataset \
- index_cache_dir=/tmp/omniglot_idx load_into_memory=true \
- total_epochs=150 remat_inner_steps=false"
-STALL_SECS=420
-MAX_RESTARTS=8
-
-run () {
-  name=$1; shift
-  out="exps/${name}.out"
-  for attempt in $(seq 0 $MAX_RESTARTS); do
-    echo "=== $(date -u +%H:%M:%S) start $name attempt=$attempt" >> exps/sweep_r3.log
-    python train_maml_system.py $COMMON experiment_name="$name" "$@" \
-      >> "$out" 2>&1 &
-    pid=$!
-    while kill -0 $pid 2>/dev/null; do
-      sleep 30
-      age=$(( $(date +%s) - $(stat -c %Y "$out") ))
-      if [ "$age" -gt "$STALL_SECS" ]; then
-        echo "=== $(date -u +%H:%M:%S) $name STALLED (log ${age}s old), killing $pid" >> exps/sweep_r3.log
-        kill $pid 2>/dev/null; sleep 5; kill -9 $pid 2>/dev/null
-        break
-      fi
-    done
-    wait $pid; rc=$?
-    echo "=== $(date -u +%H:%M:%S) $name attempt=$attempt rc=$rc" >> exps/sweep_r3.log
-    [ $rc -eq 0 ] && return 0
-    sleep 10
-  done
-  echo "=== $(date -u +%H:%M:%S) $name FAILED after $MAX_RESTARTS restarts" >> exps/sweep_r3.log
-  return 1
-}
-
-run omniglot.5.5.vgg.gd.s0      num_classes_per_set=5  num_samples_per_class=5 net=vgg
-run omniglot.5.1.resnet-4.gd.s0 num_classes_per_set=5  num_samples_per_class=1 net=resnet-4
-run omniglot.20.5.vgg.gd.s0     num_classes_per_set=20 num_samples_per_class=5 net=vgg
-echo "=== $(date -u +%H:%M:%S) PART B DONE" >> exps/sweep_r3.log
+# Round-3 accuracy matrix, part B: the configs remaining after 5w1s
+# completed (99.57% test) and 20w1s was parked for diagnosis. Thin wrapper
+# over the watchdogged harness (scripts/sweep.sh).
+exec "$(dirname "$0")/sweep.sh" \
+  "omniglot.5.5.vgg.gd.s0      num_classes_per_set=5  num_samples_per_class=5 net=vgg" \
+  "omniglot.5.1.resnet-4.gd.s0 num_classes_per_set=5  num_samples_per_class=1 net=resnet-4" \
+  "omniglot.20.5.vgg.gd.s0     num_classes_per_set=20 num_samples_per_class=5 net=vgg"

@@ -23,7 +23,14 @@ run () {
   name=$1; shift
   out="exps/${name}.out"
   for attempt in $(seq 0 $MAX_RESTARTS); do
+    # don't burn an attempt against a wedged tunnel: wait (<=1h) until a
+    # bounded probe actually sees the chip
+    python -u scripts/wait_for_tpu.py >> exps/sweep_r3.log 2>&1 || \
+      echo "=== $(date -u +%H:%M:%S) $name: TPU wait gate exited nonzero (deadline or launch failure), trying anyway" >> exps/sweep_r3.log
     echo "=== $(date -u +%H:%M:%S) start $name attempt=$attempt" >> exps/sweep_r3.log
+    # appending with >> does not update mtime on spawn: reset the liveness
+    # clock so a restart gets the full STALL_SECS window
+    touch "$out"
     python -u train_maml_system.py $COMMON experiment_name="$name" "$@" \
       >> "$out" 2>&1 &
     pid=$!
@@ -52,3 +59,4 @@ for job in "$@"; do
   run "$@" && OK=$((OK + 1))
 done
 echo "=== $(date -u +%H:%M:%S) SWEEP DONE: $OK/$TOTAL jobs" >> exps/sweep_r3.log
+[ "$OK" -eq "$TOTAL" ]

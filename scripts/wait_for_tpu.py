@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Block until the tunneled TPU backend answers, probing with short-lived
+child processes. The axon tunnel wedges for minutes at a time (server-side;
+a hung client never returns from backend init and holds nothing releasable),
+so the sweep harness calls this BEFORE each training attempt instead of
+burning watchdog restarts against a dead backend.
+
+Each probe is a separate python child (backend init happens once per
+process) killed on timeout. Exits 0 when a probe sees the TPU, 1 when the
+deadline passes.
+"""
+import subprocess
+import sys
+import time
+
+PROBE = "import jax; d = jax.devices(); print('TPU_OK', len(d), d[0].device_kind)"
+
+
+def main(deadline_s: float = 3600.0, probe_timeout_s: float = 90.0) -> int:
+    start = time.time()
+    attempt = 0
+    while time.time() - start < deadline_s:
+        attempt += 1
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", PROBE],
+                timeout=probe_timeout_s,
+                capture_output=True,
+                text=True,
+            )
+            if "TPU_OK" in out.stdout:
+                print(f"wait_for_tpu: backend up after {time.time()-start:.0f}s "
+                      f"({attempt} probes): {out.stdout.strip().splitlines()[-1]}",
+                      flush=True)
+                return 0
+        except subprocess.TimeoutExpired:
+            pass
+        print(f"wait_for_tpu: probe {attempt} failed ({time.time()-start:.0f}s elapsed)",
+              flush=True)
+        time.sleep(30)
+    print("wait_for_tpu: deadline exceeded", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(*(float(a) for a in sys.argv[1:])))
