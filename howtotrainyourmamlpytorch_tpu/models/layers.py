@@ -179,7 +179,26 @@ def batch_norm(
 
 
 def max_pool(x, window=2, stride=2):
-    """MaxPool2d(window, stride, pad=0), floor mode — matches torch default."""
+    """MaxPool2d(window, stride, pad=0), floor mode — matches torch default.
+
+    Non-overlapping pools (window == stride, the only case the model zoo
+    uses) go through slice+reshape+max instead of ``lax.reduce_window``:
+    identical windows (floor mode drops the same trailing rows/cols as
+    VALID), but the backward is an elementwise compare/select fusion rather
+    than XLA's ``select_and_scatter``, which a real v5e trace of the bench
+    step showed costing ~27% of device time together with the reduce_window
+    forward (DESIGN.md perf ledger). Deliberate subgradient difference: on a
+    window with *tied* maxima the reshape path splits the gradient evenly
+    among the ties where select_and_scatter (and torch) send it all to the
+    first argmax — both are valid subgradients; ties have measure zero in
+    f32 training and only matter under coarse quantization.
+    """
+    if window == stride:
+        b, h, w, c = x.shape
+        ho, wo = h // window, w // window
+        x = x[:, : ho * window, : wo * window, :]
+        x = x.reshape(b, ho, window, wo, window, c)
+        return x.max(axis=(2, 4))
     return lax.reduce_window(
         x,
         -jnp.inf,
@@ -191,6 +210,15 @@ def max_pool(x, window=2, stride=2):
 
 
 def avg_pool(x, window=2, stride=2):
+    """AvgPool2d(window, stride, pad=0), floor mode. Same reshape trick as
+    ``max_pool`` for the non-overlapping case (forward-only win here: the
+    backward of an average pool is already a cheap broadcast)."""
+    if window == stride:
+        b, h, w, c = x.shape
+        ho, wo = h // window, w // window
+        x = x[:, : ho * window, : wo * window, :]
+        x = x.reshape(b, ho, window, wo, window, c)
+        return x.mean(axis=(2, 4))
     summed = lax.reduce_window(
         x,
         0.0,

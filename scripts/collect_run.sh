@@ -1,0 +1,25 @@
+#!/bin/bash
+# Copy a finished run's artifacts from the (gitignored) exps/ tree into
+# results/r3/<name>/ for commit. Checkpoints stay behind (size); everything
+# the analysis pipeline reads (config.yaml, logs/*.csv, events.jsonl,
+# lrs.csv/betas.csv) comes along. Round-3 lesson: a completed run whose
+# artifacts only live in exps/ dies with the container — collect and commit
+# immediately.
+set -eu
+cd /root/repo
+name=$1
+src="exps/$name"
+dst="results/r3/$name"
+[ -d "$src" ] || { echo "no such run dir: $src" >&2; exit 1; }
+rm -rf "$dst"   # re-collection replaces; cp -r into an existing dir would nest logs/logs
+mkdir -p "$dst"
+cp "$src/config.yaml" "$dst/"
+cp -r "$src/logs" "$dst/logs"
+for f in lrs.csv betas.csv; do
+  if [ -f "$src/$f" ]; then cp "$src/$f" "$dst/"; fi
+done
+# the driver-visible training log too (epoch lines, resume/watchdog events)
+if [ -f "exps/$name.out" ]; then
+  grep -v '^WARNING' "exps/$name.out" > "$dst/train.out" || true
+fi
+echo "collected $src -> $dst"

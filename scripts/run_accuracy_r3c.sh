@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-3 accuracy matrix, part C: full re-run after the container reset
+# wiped exps/ (the earlier 5w1s completion at 99.57% test lost its
+# artifacts — this time each finished run is copied into results/ and
+# committed immediately). Priority order: the three headline VGG configs
+# first, then the resnet-4 backbone, then 20w1s (parked earlier for
+# diagnosis — run last and watch its curve).
+mkdir -p /root/repo/exps
+exec "$(dirname "$0")/sweep.sh" \
+  "omniglot.5.1.vgg.gd.s0      num_classes_per_set=5  num_samples_per_class=1 net=vgg" \
+  "omniglot.5.5.vgg.gd.s0      num_classes_per_set=5  num_samples_per_class=5 net=vgg" \
+  "omniglot.20.5.vgg.gd.s0     num_classes_per_set=20 num_samples_per_class=5 net=vgg" \
+  "omniglot.5.1.resnet-4.gd.s0 num_classes_per_set=5  num_samples_per_class=1 net=resnet-4" \
+  "omniglot.20.1.vgg.gd.s0     num_classes_per_set=20 num_samples_per_class=1 net=vgg"

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-3 accuracy matrix, part D (runs after part C): the axes part C
+# doesn't cover — the inner-optimizer ablation (the fork's whole point:
+# model x inner-opt ablated independently), the third backbone family
+# (densenet-8), and two more seeds of the headline 5w1s config for a true
+# 3-seed mean like the reference's notebook aggregation.
+# Reference anchors (BASELINE.md): 5.1 vgg+Adam 99.62+-0.08,
+# 5.1 densenet-8+SGD 99.54+-0.33, 5.1 vgg+SGD 99.62+-0.08.
+# Note: seed overrides must come AFTER the COMMON block's seed=0 (last
+# occurrence wins in the config override parser).
+mkdir -p /root/repo/exps
+exec "$(dirname "$0")/sweep.sh" \
+  "omniglot.5.1.vgg.adam.s0       num_classes_per_set=5 num_samples_per_class=1 net=vgg inner_optim=adam" \
+  "omniglot.5.1.densenet-8.gd.s0  num_classes_per_set=5 num_samples_per_class=1 net=densenet-8" \
+  "omniglot.5.1.vgg.gd.s1         num_classes_per_set=5 num_samples_per_class=1 net=vgg seed=1 train_seed=1" \
+  "omniglot.5.1.vgg.gd.s2         num_classes_per_set=5 num_samples_per_class=1 net=vgg seed=2 train_seed=2"

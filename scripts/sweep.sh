@@ -16,7 +16,12 @@ COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
  dataset.path=/root/reference/datasets/omniglot_dataset \
  index_cache_dir=/tmp/omniglot_idx load_into_memory=true \
  total_epochs=150 remat_inner_steps=false"
-STALL_SECS=${STALL_SECS:-240}   # epochs are 6-90s; 240s of silence = wedged
+# Epochs print every 6-90s once warm, but epoch 0 of the heavy 20-way /
+# resnet / densenet configs is compile (+eval-program compile) plus 500
+# silent train iters — comfortably over 240s on a cold XLA cache. 420s still
+# catches a wedged tunnel within one epoch's slack without kill-looping a
+# healthy first epoch.
+STALL_SECS=${STALL_SECS:-420}
 MAX_RESTARTS=${MAX_RESTARTS:-8}
 
 run () {
