@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-3 accuracy matrix, part E (runs after part D if chip time remains):
+# widen the model x inner-opt ablation grid — the deeper resnet backbones at
+# 20-way and a second/third Adam cell, mirroring the reference's published
+# grid (BASELINE.md). DEADLINE_EPOCH guards each job start so the chip is
+# free for the driver's end-of-round bench.
+# Reference anchors: 20.5 resnet-8+SGD 99.76+-0.01 (best published 20w5s),
+# 20.1 resnet-12+SGD 99.00+-0.33 (best published 20w1s),
+# 5.5 vgg+Adam 99.86+-0.04, 20.5 vgg+Adam 98.74+-0.04.
+mkdir -p /root/repo/exps
+exec "$(dirname "$0")/sweep.sh" \
+  "omniglot.20.5.resnet-8.gd.s0   num_classes_per_set=20 num_samples_per_class=5 net=resnet-8" \
+  "omniglot.5.5.vgg.adam.s0       num_classes_per_set=5  num_samples_per_class=5 net=vgg inner_optim=adam" \
+  "omniglot.20.1.resnet-12.gd.s0  num_classes_per_set=20 num_samples_per_class=1 net=resnet-12" \
+  "omniglot.20.5.vgg.adam.s0      num_classes_per_set=20 num_samples_per_class=5 net=vgg inner_optim=adam"

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Print the measured cells of a RESULTS.md accuracy-table row for finished
+run directories.
+
+Usage: python scripts/summarize_run.py exps/<name> [exps/<name2> ...]
+
+Parsing rides on ``analysis.load_run`` (the single owner of the run-artifact
+contract, incl. ``''``-cell handling on header-reconciled CSVs). Wall-clock
+is end-to-end from the ``logs/events.jsonl`` timestamps — train AND val eval
+time — extrapolated by one epoch for epoch 0 (the first event is stamped at
+the *end* of epoch 0). The Reference / Δ columns come from BASELINE.md by
+hand; placeholders keep the emitted row aligned with the 5-column table.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from howtotrainyourmamlpytorch_tpu.analysis import load_run  # noqa: E402
+
+
+def row(run_dir: str) -> str:
+    rec = load_run(run_dir)
+    if rec is None or not rec.test:
+        return f"| {run_dir} | (no test_summary.csv — unfinished?) | | | |"
+    test = rec.test[-1]
+    acc = 100 * test["test_accuracy_mean"]
+    ci = 100 * test["test_accuracy_ci95"]
+    n = int(test["test_num_episodes"])
+    wall = "?"
+    events = os.path.join(run_dir, "logs", "events.jsonl")
+    try:
+        ts = [json.loads(line)["ts"] for line in open(events) if line.strip()]
+        if len(ts) > 1:
+            mins = (ts[-1] - ts[0]) / 60 * len(ts) / (len(ts) - 1)
+            wall = f"≈{mins:.0f} min"
+    except (OSError, ValueError, KeyError):
+        pass
+    name = run_dir.rstrip("/").split("/")[-1]
+    return f"| {name} | (ref: BASELINE.md) | {acc:.2f} ± {ci:.2f} % (n={n}) | Δ | {wall} |"
+
+
+if __name__ == "__main__":
+    for d in sys.argv[1:]:
+        print(row(d))

@@ -60,6 +60,12 @@ run () {
 TOTAL=$#
 OK=0
 for job in "$@"; do
+  # optional deadline (epoch seconds): don't *start* a job that would
+  # overrun the round — the driver needs the chip free at round end.
+  if [ -n "${DEADLINE_EPOCH:-}" ] && [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; then
+    echo "=== $(date -u +%H:%M:%S) DEADLINE passed, skipping remaining jobs" >> exps/sweep_r3.log
+    break
+  fi
   set -- $job
   run "$@" && OK=$((OK + 1))
 done
