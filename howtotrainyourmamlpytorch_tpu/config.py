@@ -128,6 +128,11 @@ class Config:
                 f"test_ensemble_top_k ({self.test_ensemble_top_k}) cannot "
                 f"exceed max_models_to_save ({self.max_models_to_save})"
             )
+        if self.matmul_precision not in ("default", "high", "highest"):
+            raise ValueError(
+                f"matmul_precision must be 'default', 'high' or 'highest', "
+                f"got {self.matmul_precision!r}"
+            )
 
     # --- episode shape (reference config.yaml:22-26) ---
     num_classes_per_set: int = 20
@@ -209,6 +214,15 @@ class Config:
     # (custom VJP; parity-tested). SGD/gd inner optimizer only.
     use_pallas_inner_update: bool = False
     profile_dir: str = ""  # non-empty: write jax.profiler traces here
+    # XLA matmul/conv precision for f32 operands. On TPU the "default" is a
+    # single bfloat16 MXU pass (8-bit mantissa) even when tensors are f32 —
+    # fine for forward inference, but the unrolled second-order meta-gradient
+    # is a small residual of large terms and can drown in that rounding on
+    # hard (large-n_way) tasks while easy tasks still train. "high" =
+    # 3-pass bf16 (~f32 quality at ~2-3x matmul cost), "highest" = full f32
+    # emulation (~6 passes). Applied process-wide by the entry point /
+    # MAMLSystem via jax.config jax_default_matmul_precision.
+    matmul_precision: str = "default"  # default | high | highest
 
     # ------------------------------------------------------------------
     @property

@@ -94,6 +94,12 @@ class MAMLSystem:
         )
         self.outer_opt = optax.adam(learning_rate=self.schedule)
         self.compute_dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+        # process-wide (jax has no per-program toggle for the compiled train
+        # step's whole dot/conv population); applied unconditionally so the
+        # last-constructed system's config always wins and a 'high'/'highest'
+        # from an earlier system in the same process can't silently leak into
+        # a later default-precision one
+        jax.config.update("jax_default_matmul_precision", cfg.matmul_precision)
 
         # Compiled program cache keyed by the static switches: (second_order,
         # msl_active). msl_active selects the rollout shape — per-step target

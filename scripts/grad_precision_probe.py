@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Probe: is the TPU's default (bf16-pass) matmul precision destroying the
+second-order MAML meta-gradient at 20-way?
+
+Computes the meta-gradient of one fixed synthetic batch at init on the
+current backend and prints per-tensor grad norms plus cosine similarity
+against a saved CPU float32 reference (ground truth, true f32 matmuls).
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/grad_precision_probe.py save /tmp/grads_cpu.npz
+  python scripts/grad_precision_probe.py compare /tmp/grads_cpu.npz          # TPU default
+  JAX_DEFAULT_MATMUL_PRECISION=highest python scripts/grad_precision_probe.py compare /tmp/grads_cpu.npz
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def meta_grads(n_way=20, k_shot=5, compute_dtype="float32"):
+    import jax
+    import jax.numpy as jnp
+
+    from howtotrainyourmamlpytorch_tpu.config import Config
+    from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+    from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+
+    if compute_dtype == "mxu_default":
+        # Exact CPU emulation of the TPU MXU's DEFAULT-precision pass:
+        # operands rounded to bf16, multiplied and accumulated in f32
+        # (a bf16 x bf16 product is exactly representable in f32, so
+        # rounding the operands then running the f32 conv reproduces the
+        # MXU result up to accumulation order). Elementwise ops stay f32,
+        # as on the real chip.
+        from howtotrainyourmamlpytorch_tpu.models import layers as L
+
+        orig_conv2d = L.conv2d
+
+        def conv2d_bf16_operands(params, x, stride=1, padding=0):
+            r = lambda a: a.astype(jnp.bfloat16).astype(jnp.float32)
+            p = dict(params, w=r(params["w"]))
+            return orig_conv2d(p, r(x), stride=stride, padding=padding)
+
+        orig_linear = L.linear
+
+        def linear_bf16_operands(params, x):
+            r = lambda a: a.astype(jnp.bfloat16).astype(jnp.float32)
+            return r(x) @ r(params["w"]) + params["b"]
+
+        L.conv2d = conv2d_bf16_operands
+        L.linear = linear_bf16_operands
+        # the models capture layers.conv2d/linear at call time via module
+        # attr, so patching the module attributes is enough
+        compute_dtype = "float32"
+
+    cfg = Config(
+        num_classes_per_set=n_way,
+        num_samples_per_class=k_shot,
+        compute_dtype=compute_dtype,
+    )
+    system = MAMLSystem(cfg)
+    state = system.init_train_state()
+    batch = {
+        k: jnp.asarray(v)
+        for k, v in synthetic_batch(
+            cfg.batch_size, n_way, k_shot, cfg.num_target_samples,
+            cfg.image_shape, seed=0,
+        ).items()
+    }
+    trainables = {"params": state.params, "hparams": state.inner_hparams}
+
+    def objective(tr):
+        loss, _ = system._meta_objective(
+            tr, state.bn_state, state.opt_state, batch, 0, True,
+            cfg.number_of_training_steps_per_iter, True,
+        )
+        return loss
+
+    grads = jax.jit(jax.grad(objective))(trainables)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(grads)
+    flat = {jax.tree_util.keystr(path): np.asarray(leaf, np.float64) for path, leaf in leaves}
+    return flat
+
+
+def main():
+    mode, path = sys.argv[1], sys.argv[2]
+    n_way = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+    dtype = sys.argv[4] if len(sys.argv) > 4 else "float32"
+    import jax
+
+    # the machine's site hook forces jax_platforms='axon,cpu', overriding the
+    # JAX_PLATFORMS env var — re-assert it (same dance as train_maml_system.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    flat = meta_grads(n_way=n_way, compute_dtype=dtype)
+    print(f"backend={jax.default_backend()} n_way={n_way} dtype={dtype}")
+    if mode == "save":
+        np.savez(path, **flat)
+        print(f"saved {len(flat)} grad tensors -> {path}")
+        return
+    ref = np.load(path)
+    worst = 1.0
+    for name, g in sorted(flat.items()):
+        r = ref[name]
+        denom = np.linalg.norm(g) * np.linalg.norm(r)
+        cos = float((g * r).sum() / denom) if denom > 0 else float("nan")
+        worst = min(worst, cos if cos == cos else worst)
+        print(f"{name:55s} |g|={np.linalg.norm(g):9.3e} |ref|={np.linalg.norm(r):9.3e} cos={cos:+.4f}")
+    print(f"worst cosine vs CPU-f32: {worst:+.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -93,3 +93,23 @@ def test_yaml_roundtrip(tmp_path):
 def test_run_name_matches_reference_scheme():
     cfg = Config()
     assert cfg.run_name() == "omniglot_dataset.20.5"
+
+
+def test_matmul_precision_knob():
+    """matmul_precision validates its values and reaches jax config when a
+    MAMLSystem is built (TPU default precision does bf16-pass matmuls on f32
+    operands; accuracy-parity runs need 'high'/'highest')."""
+    import jax
+    import pytest
+
+    from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+
+    with pytest.raises(ValueError, match="matmul_precision"):
+        Config(matmul_precision="fast")
+    before = jax.config.jax_default_matmul_precision
+    try:
+        MAMLSystem(Config(matmul_precision="high", num_classes_per_set=3,
+                          num_samples_per_class=1))
+        assert jax.config.jax_default_matmul_precision == "high"
+    finally:
+        jax.config.update("jax_default_matmul_precision", before)
