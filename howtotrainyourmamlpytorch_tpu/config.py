@@ -223,6 +223,13 @@ class Config:
     # emulation (~6 passes). Applied process-wide by the entry point /
     # MAMLSystem via jax.config jax_default_matmul_precision.
     matmul_precision: str = "default"  # default | high | highest
+    # Donate the TrainState buffers to the compiled train step (halves HBM
+    # for the state and lets XLA update in place). Off = keep inputs alive —
+    # a diagnostic/workaround switch for PJRT plugins whose input/output
+    # aliasing is suspect (donation is ignored on CPU, so a donation bug is
+    # exactly the kind of failure that reproduces on a device but not in
+    # CPU tests).
+    donate_train_state: bool = True
 
     # ------------------------------------------------------------------
     @property

@@ -429,11 +429,12 @@ class MAMLSystem:
     def _compiled_train_step(self, second_order: bool, msl_active: bool):
         key = (second_order, msl_active)
         if key not in self._train_step_cache:
+            donate = (0,) if self.cfg.donate_train_state else ()
             self._train_step_cache[key] = jax.jit(
                 functools.partial(
                     self._train_step_impl, second_order=second_order, msl_active=msl_active
                 ),
-                donate_argnums=(0,),
+                donate_argnums=donate,
             )
         return self._train_step_cache[key]
 

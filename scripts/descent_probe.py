@@ -1,0 +1,33 @@
+"""Repeated-batch descent probe: can the full meta-step (second order, MSL,
+LSLR, outer Adam) descend on ONE fixed real 20-way batch? f32 vs exact
+MXU-default emulation. Argv: [emulate?0/1] [n_way] [steps]"""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+import jax
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+import jax.numpy as jnp
+emulate, n_way, steps = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+if emulate:
+    from howtotrainyourmamlpytorch_tpu.models import layers as L
+    _conv, _lin = L.conv2d, L.linear
+    r = lambda a: a.astype(jnp.bfloat16).astype(jnp.float32)
+    L.conv2d = lambda p, x, stride=1, padding=0: _conv(dict(p, w=r(p["w"])), r(x), stride=stride, padding=padding)
+    L.linear = lambda p, x: r(x) @ r(p["w"]) + p["b"]
+from howtotrainyourmamlpytorch_tpu.config import Config, DatasetConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data import MetaLearningDataLoader
+cfg = Config(dataset=DatasetConfig(name="omniglot_dataset", path="datasets/omniglot_dataset"),
+             num_classes_per_set=n_way, num_samples_per_class=1, num_target_samples=1,
+             batch_size=4, load_into_memory=False, index_cache_dir="/tmp/omniglot_idx",
+             unroll_inner_steps=False, remat_inner_steps=False)
+loader = MetaLearningDataLoader(cfg, current_iter=0, data_root="/root/reference")
+batch = next(iter(loader.train_batches(1, augment_images=True)))
+batch = {k: jnp.asarray(v) for k, v in batch.items()}
+system = MAMLSystem(cfg)
+state = system.init_train_state()
+print(f"emulate={emulate} n_way={n_way} backend={jax.default_backend()}", flush=True)
+for i in range(steps):
+    state, out = system.train_step(state, batch, epoch=0)
+    if i % 10 == 0 or i == steps - 1:
+        print(f"step {i:3d} loss={float(out.loss):.4f} acc={float(out.accuracy):.4f}", flush=True)

@@ -1,0 +1,58 @@
+#!/bin/bash
+# On-chip 20-way diagnostic chain (results/r3/DIAG_20way.md next-steps).
+# Gates on the tunnel before EVERY step (it wedges for hours; a single
+# up-front gate would let later steps burn their whole timeout against a
+# dead backend), then runs, logging into exps/diag/:
+#  1. descent probe on the chip — can it descend on one fixed 20-way batch
+#     that CPU descends on under worse precision?
+#  2. 3-epoch 20w5s stream run with donate_train_state=false — input/output
+#     aliasing suspect: donation is ignored on CPU, so a plugin aliasing bug
+#     reproduces on-device only, and corrupted state accumulating across
+#     steps matches the observed "epoch 0 learns, then collapse".
+#  3. 3-epoch 20w5s stream run with matmul_precision=high — isolates the
+#     MXU bf16 default pass.
+#  4. 3-epoch 20w5s stream run with rolled scan + remat — a different XLA
+#     program family; dodges a possible miscompile of the big unrolled
+#     second-order graph.
+set -u
+cd /root/repo
+mkdir -p exps/diag
+LOG=exps/diag/chain.log
+
+gate () {
+  echo "=== $(date -u +%H:%M:%S) gate for $1" >> "$LOG"
+  python -u scripts/wait_for_tpu.py "${2:-18000}" 60 >> "$LOG" 2>&1 || {
+    echo "=== $(date -u +%H:%M:%S) gate deadline passed before $1, aborting" >> "$LOG"
+    exit 1
+  }
+}
+
+gate "descent probe" 18000
+echo "=== $(date -u +%H:%M:%S) [1/4] on-chip descent probe" >> "$LOG"
+timeout 900 python -u scripts/descent_probe.py 0 20 25 >> "$LOG" 2>&1
+echo "=== probe rc=$?" >> "$LOG"
+
+COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
+ dataset.path=/root/reference/datasets/omniglot_dataset \
+ index_cache_dir=/tmp/omniglot_idx load_into_memory=true \
+ num_classes_per_set=20 num_samples_per_class=5 net=vgg total_epochs=3 \
+ experiment_root=exps/diag"
+
+gate "X8 donation-off" 3600
+echo "=== $(date -u +%H:%M:%S) [2/4] stream 3ep donation OFF (aliasing suspect)" >> "$LOG"
+timeout 2400 python -u train_maml_system.py $COMMON remat_inner_steps=false \
+  donate_train_state=false experiment_name=X8.nodonate >> "$LOG" 2>&1
+echo "=== X8 rc=$?" >> "$LOG"
+
+gate "X3 precision-high" 3600
+echo "=== $(date -u +%H:%M:%S) [3/4] stream 3ep matmul_precision=high" >> "$LOG"
+timeout 2400 python -u train_maml_system.py $COMMON remat_inner_steps=false \
+  matmul_precision=high experiment_name=X3.high >> "$LOG" 2>&1
+echo "=== X3 rc=$?" >> "$LOG"
+
+gate "X7 rolled+remat" 3600
+echo "=== $(date -u +%H:%M:%S) [4/4] stream 3ep rolled scan + remat" >> "$LOG"
+timeout 2400 python -u train_maml_system.py $COMMON remat_inner_steps=true \
+  unroll_inner_steps=false experiment_name=X7.rolled >> "$LOG" 2>&1
+echo "=== X7 rc=$?" >> "$LOG"
+echo "=== $(date -u +%H:%M:%S) diag chain done" >> "$LOG"
