@@ -1,32 +1,62 @@
 """Repeated-batch descent probe: can the full meta-step (second order, MSL,
-LSLR, outer Adam) descend on ONE fixed real 20-way batch? f32 vs exact
-MXU-default emulation. Argv: [emulate?0/1] [n_way] [steps]"""
-import os, sys
+LSLR, outer Adam) descend on ONE fixed real 20-way batch?
+
+Argv: [emulate 0/1] [n_way] [steps] [unroll 0/1, default 1]
+
+`unroll=1` (default) compiles the SAME fully-unrolled second-order XLA
+program family the production sweep runs use (sweep.sh leaves
+unroll_inner_steps at its default True) — required when the probe's verdict
+is about the platform's handling of that program. `unroll=0` is the rolled
+variant (used for CPU arms, where the unrolled graph compiles too slowly).
+`emulate=1` applies the shared bf16-operand MXU-default emulation from
+grad_precision_probe.py (CPU arms only).
+"""
+import os
+import sys
+
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 import jax
+
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 import jax.numpy as jnp
-emulate, n_way, steps = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+emulate = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+n_way = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+steps = int(sys.argv[3]) if len(sys.argv) > 3 else 25
+unroll = bool(int(sys.argv[4])) if len(sys.argv) > 4 else True
+
 if emulate:
-    from howtotrainyourmamlpytorch_tpu.models import layers as L
-    _conv, _lin = L.conv2d, L.linear
-    r = lambda a: a.astype(jnp.bfloat16).astype(jnp.float32)
-    L.conv2d = lambda p, x, stride=1, padding=0: _conv(dict(p, w=r(p["w"])), r(x), stride=stride, padding=padding)
-    L.linear = lambda p, x: r(x) @ r(p["w"]) + p["b"]
+    from grad_precision_probe import apply_mxu_default_emulation
+
+    apply_mxu_default_emulation()
+
 from howtotrainyourmamlpytorch_tpu.config import Config, DatasetConfig
 from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
 from howtotrainyourmamlpytorch_tpu.data import MetaLearningDataLoader
-cfg = Config(dataset=DatasetConfig(name="omniglot_dataset", path="datasets/omniglot_dataset"),
-             num_classes_per_set=n_way, num_samples_per_class=1, num_target_samples=1,
-             batch_size=4, load_into_memory=False, index_cache_dir="/tmp/omniglot_idx",
-             unroll_inner_steps=False, remat_inner_steps=False)
+
+cfg = Config(
+    dataset=DatasetConfig(name="omniglot_dataset", path="datasets/omniglot_dataset"),
+    num_classes_per_set=n_way,
+    num_samples_per_class=1,
+    num_target_samples=1,
+    batch_size=4,
+    load_into_memory=False,
+    index_cache_dir="/tmp/omniglot_idx",
+    unroll_inner_steps=unroll,
+    remat_inner_steps=False,
+)
 loader = MetaLearningDataLoader(cfg, current_iter=0, data_root="/root/reference")
 batch = next(iter(loader.train_batches(1, augment_images=True)))
 batch = {k: jnp.asarray(v) for k, v in batch.items()}
 system = MAMLSystem(cfg)
 state = system.init_train_state()
-print(f"emulate={emulate} n_way={n_way} backend={jax.default_backend()}", flush=True)
+print(
+    f"emulate={emulate} n_way={n_way} unroll={unroll} backend={jax.default_backend()}",
+    flush=True,
+)
 for i in range(steps):
     state, out = system.train_step(state, batch, epoch=0)
     if i % 10 == 0 or i == steps - 1:

@@ -28,9 +28,13 @@ gate () {
 }
 
 gate "descent probe" 18000
-echo "=== $(date -u +%H:%M:%S) [1/4] on-chip descent probe" >> "$LOG"
-timeout 900 python -u scripts/descent_probe.py 0 20 25 >> "$LOG" 2>&1
-echo "=== probe rc=$?" >> "$LOG"
+echo "=== $(date -u +%H:%M:%S) [1/4] on-chip descent probe, UNROLLED (the production program family)" >> "$LOG"
+timeout --kill-after=30 900 python -u scripts/descent_probe.py 0 20 25 1 >> "$LOG" 2>&1
+echo "=== probe(unrolled) rc=$?" >> "$LOG"
+gate "descent probe rolled" 3600
+echo "=== $(date -u +%H:%M:%S) [1b/4] on-chip descent probe, rolled variant" >> "$LOG"
+timeout --kill-after=30 900 python -u scripts/descent_probe.py 0 20 25 0 >> "$LOG" 2>&1
+echo "=== probe(rolled) rc=$?" >> "$LOG"
 
 COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
  dataset.path=/root/reference/datasets/omniglot_dataset \
@@ -40,19 +44,19 @@ COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
 
 gate "X8 donation-off" 3600
 echo "=== $(date -u +%H:%M:%S) [2/4] stream 3ep donation OFF (aliasing suspect)" >> "$LOG"
-timeout 2400 python -u train_maml_system.py $COMMON remat_inner_steps=false \
+timeout --kill-after=30 2400 python -u train_maml_system.py $COMMON remat_inner_steps=false \
   donate_train_state=false experiment_name=X8.nodonate >> "$LOG" 2>&1
 echo "=== X8 rc=$?" >> "$LOG"
 
 gate "X3 precision-high" 3600
 echo "=== $(date -u +%H:%M:%S) [3/4] stream 3ep matmul_precision=high" >> "$LOG"
-timeout 2400 python -u train_maml_system.py $COMMON remat_inner_steps=false \
+timeout --kill-after=30 2400 python -u train_maml_system.py $COMMON remat_inner_steps=false \
   matmul_precision=high experiment_name=X3.high >> "$LOG" 2>&1
 echo "=== X3 rc=$?" >> "$LOG"
 
 gate "X7 rolled+remat" 3600
 echo "=== $(date -u +%H:%M:%S) [4/4] stream 3ep rolled scan + remat" >> "$LOG"
-timeout 2400 python -u train_maml_system.py $COMMON remat_inner_steps=true \
+timeout --kill-after=30 2400 python -u train_maml_system.py $COMMON remat_inner_steps=true \
   unroll_inner_steps=false experiment_name=X7.rolled >> "$LOG" 2>&1
 echo "=== X7 rc=$?" >> "$LOG"
 echo "=== $(date -u +%H:%M:%S) diag chain done" >> "$LOG"

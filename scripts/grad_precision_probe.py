@@ -19,6 +19,37 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def apply_mxu_default_emulation():
+    """Exact CPU emulation of the TPU MXU's DEFAULT-precision pass, patched
+    into the layer primitives: conv/linear operands rounded to bf16,
+    multiplied and accumulated in f32 (a bf16 x bf16 product is exactly
+    representable in f32, so rounding the operands then running the f32
+    conv reproduces the MXU result up to accumulation order). Elementwise
+    ops stay f32, as on the real chip. The models capture
+    ``layers.conv2d``/``layers.linear`` at call time via module attribute,
+    so patching the module attributes is enough. Shared by
+    grad_precision_probe.py and descent_probe.py so the two probes can't
+    drift on what 'MXU default' means."""
+    import jax.numpy as jnp
+
+    from howtotrainyourmamlpytorch_tpu.models import layers as L
+
+    orig_conv2d = L.conv2d
+
+    def r(a):
+        return a.astype(jnp.bfloat16).astype(jnp.float32)
+
+    def conv2d_bf16_operands(params, x, stride=1, padding=0):
+        p = dict(params, w=r(params["w"]))
+        return orig_conv2d(p, r(x), stride=stride, padding=padding)
+
+    def linear_bf16_operands(params, x):
+        return r(x) @ r(params["w"]) + params["b"]
+
+    L.conv2d = conv2d_bf16_operands
+    L.linear = linear_bf16_operands
+
+
 def meta_grads(n_way=20, k_shot=5, compute_dtype="float32"):
     import jax
     import jax.numpy as jnp
@@ -28,31 +59,7 @@ def meta_grads(n_way=20, k_shot=5, compute_dtype="float32"):
     from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
 
     if compute_dtype == "mxu_default":
-        # Exact CPU emulation of the TPU MXU's DEFAULT-precision pass:
-        # operands rounded to bf16, multiplied and accumulated in f32
-        # (a bf16 x bf16 product is exactly representable in f32, so
-        # rounding the operands then running the f32 conv reproduces the
-        # MXU result up to accumulation order). Elementwise ops stay f32,
-        # as on the real chip.
-        from howtotrainyourmamlpytorch_tpu.models import layers as L
-
-        orig_conv2d = L.conv2d
-
-        def conv2d_bf16_operands(params, x, stride=1, padding=0):
-            r = lambda a: a.astype(jnp.bfloat16).astype(jnp.float32)
-            p = dict(params, w=r(params["w"]))
-            return orig_conv2d(p, r(x), stride=stride, padding=padding)
-
-        orig_linear = L.linear
-
-        def linear_bf16_operands(params, x):
-            r = lambda a: a.astype(jnp.bfloat16).astype(jnp.float32)
-            return r(x) @ r(params["w"]) + params["b"]
-
-        L.conv2d = conv2d_bf16_operands
-        L.linear = linear_bf16_operands
-        # the models capture layers.conv2d/linear at call time via module
-        # attr, so patching the module attributes is enough
+        apply_mxu_default_emulation()
         compute_dtype = "float32"
 
     cfg = Config(

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-3 accuracy part F — post-diagnosis queue. Usage:
+#   scripts/run_accuracy_r3f.sh [extra override ...]
+# Runs the remaining headline configs; pass the 20-way fix discovered by
+# diag_chain (e.g. donate_train_state=false) as extra overrides, applied to
+# every job. resnet-4 5w1s goes first (5-way family is proven stable, so it
+# banks a third full-budget row even if the 20-way fix is wrong).
+# DEADLINE_EPOCH honored by sweep.sh so nothing overruns the round.
+mkdir -p /root/repo/exps
+EXTRA="$*"
+exec "$(dirname "$0")/sweep.sh" \
+  "omniglot.5.1.resnet-4.gd.s0 num_classes_per_set=5  num_samples_per_class=1 net=resnet-4 $EXTRA" \
+  "omniglot.20.5.vgg.gd.s0     num_classes_per_set=20 num_samples_per_class=5 net=vgg $EXTRA" \
+  "omniglot.20.1.vgg.gd.s0     num_classes_per_set=20 num_samples_per_class=1 net=vgg $EXTRA" \
+  "omniglot.5.1.vgg.adam.s0    num_classes_per_set=5  num_samples_per_class=1 net=vgg inner_optim=adam $EXTRA"
