@@ -26,7 +26,10 @@ import jax.numpy as jnp
 emulate = int(sys.argv[1]) if len(sys.argv) > 1 else 0
 n_way = int(sys.argv[2]) if len(sys.argv) > 2 else 20
 steps = int(sys.argv[3]) if len(sys.argv) > 3 else 25
-unroll = bool(int(sys.argv[4])) if len(sys.argv) > 4 else True
+# emulation arms are CPU-only, where the unrolled 20-way graph compiles too
+# slowly — default them to the rolled program; on-chip (emulate=0) arms
+# default to the production unrolled program. Explicit 4th arg wins.
+unroll = bool(int(sys.argv[4])) if len(sys.argv) > 4 else not emulate
 
 if emulate:
     from grad_precision_probe import apply_mxu_default_emulation

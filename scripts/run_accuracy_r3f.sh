@@ -5,7 +5,9 @@
 # diag_chain (e.g. donate_train_state=false) as extra overrides, applied to
 # every job. resnet-4 5w1s goes first (5-way family is proven stable, so it
 # banks a third full-budget row even if the 20-way fix is wrong).
-# DEADLINE_EPOCH honored by sweep.sh so nothing overruns the round.
+# DEADLINE_EPOCH (sweep.sh) gates job STARTS only — a job that begins just
+# before the deadline still runs to completion, so set the deadline at
+# least one full run-length before the chip must be free.
 mkdir -p /root/repo/exps
 EXTRA="$*"
 exec "$(dirname "$0")/sweep.sh" \

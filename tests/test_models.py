@@ -172,3 +172,15 @@ def test_avg_pool_matches_torch_floor_mode():
     )
     assert ours.shape == theirs.shape == (1, 3, 3, 2)
     np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-5, atol=1e-6)
+
+
+def test_max_pool_tie_subgradient_convention():
+    """On exactly-tied window maxima the reshape path splits the gradient
+    evenly among ties (documented deliberate difference from torch's/
+    select_and_scatter's first-argmax convention — see max_pool docstring)."""
+    x = jnp.zeros((1, 2, 2, 1), np.float32).at[0, 0, 0, 0].set(1.0).at[0, 1, 1, 0].set(1.0)
+    g = jax.grad(lambda a: jnp.sum(layers.max_pool(a)))(x)
+    np.testing.assert_allclose(np.asarray(g).squeeze(), [[0.5, 0.0], [0.0, 0.5]])
+    x_all_tied = jnp.ones((1, 2, 2, 1), np.float32)
+    g2 = jax.grad(lambda a: jnp.sum(layers.max_pool(a)))(x_all_tied)
+    np.testing.assert_allclose(np.asarray(g2), 0.25 * np.ones((1, 2, 2, 1)))
