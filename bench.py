@@ -30,6 +30,11 @@ os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 
 REFERENCE_STEPS_PER_SEC = 2.6  # fastest plausible single-GPU reference (see docstring)
 STARTUP_TIMEOUT_S = 90.0
+# The axon tunnel wedges for minutes-to-hours at a time (server-side). A
+# single in-process init attempt cannot be retried (backend init happens once
+# per process), so before touching the backend in-process we wait for it with
+# short-lived child probes, up to this deadline (overridable for CI).
+STARTUP_DEADLINE_S = float(os.environ.get("BENCH_STARTUP_DEADLINE_S", 1800.0))
 METRIC = "meta_steps_per_sec_omniglot20w5s_vgg_b8_5steps_2nd_order"
 
 # Dense bf16 peak FLOP/s per chip, keyed by substring of device_kind.
@@ -60,10 +65,35 @@ def _fail(msg: str, rc: int = 2) -> None:
     os._exit(rc)
 
 
+def _wait_for_backend(deadline_s: float) -> None:
+    """Wait for the backend to answer before any in-process contact (backend
+    init is once-per-process, so a wedged tunnel can only be retried from a
+    fresh process). Shares the single "backend up" definition with the sweep
+    gate (scripts/wait_for_tpu.py) — notably, jax's silent CPU fallback does
+    NOT count unless BENCH_ALLOW_CPU=1, because benching the 20-way
+    second-order program on one CPU core is a garbage number against a
+    per-chip baseline. Falls through after the deadline and lets the
+    in-process contact produce the structured failure."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+    )
+    from wait_for_tpu import wait_for_backend
+
+    wait_for_backend(
+        deadline_s,
+        STARTUP_TIMEOUT_S,
+        allow_cpu=os.environ.get("BENCH_ALLOW_CPU") == "1",
+        label="bench",
+        log=lambda m: print(m, file=sys.stderr, flush=True),
+    )
+
+
 def _contact_device():
     """First device contact, bounded by STARTUP_TIMEOUT_S (the backend may be
     a tunneled remote TPU that hangs on init when unreachable)."""
     import concurrent.futures
+
+    _wait_for_backend(STARTUP_DEADLINE_S)
 
     def probe():
         import jax
@@ -76,7 +106,11 @@ def _contact_device():
     try:
         return fut.result(timeout=STARTUP_TIMEOUT_S)
     except concurrent.futures.TimeoutError:
-        _fail(f"backend init did not complete within {STARTUP_TIMEOUT_S:.0f}s")
+        _fail(
+            "backend init did not complete within "
+            f"{STARTUP_TIMEOUT_S:.0f}s (after waiting up to "
+            f"{STARTUP_DEADLINE_S:.0f}s for a child probe to see the backend)"
+        )
     except Exception as e:  # backend UNAVAILABLE etc.
         _fail(f"backend init failed: {type(e).__name__}: {e}")
 
@@ -96,6 +130,12 @@ def main():
         file=sys.stderr,
         flush=True,
     )
+    if platform == "cpu" and os.environ.get("BENCH_ALLOW_CPU") != "1":
+        _fail(
+            "backend fell back to host CPU (tunneled TPU plugin failed); "
+            "a single-core CPU number is not comparable to the per-chip "
+            "baseline — set BENCH_ALLOW_CPU=1 to bench on CPU anyway"
+        )
 
     import jax
     import jax.numpy as jnp

@@ -230,6 +230,14 @@ class Config:
     # exactly the kind of failure that reproduces on a device but not in
     # CPU tests).
     donate_train_state: bool = True
+    # Force the lax.reduce_window max-pool path (select_and_scatter backward
+    # == torch's first-argmax tie subgradient) instead of the faster
+    # reshape+max path (even-split tie subgradient). The conventions differ
+    # only on tied window maxima — measure-zero in f32 but plausible under
+    # bfloat16 quantization — so this is the escape hatch for ruling the
+    # pooling convention in/out during on-chip mixed-precision parity
+    # debugging (see models/layers.py max_pool docstring, PARITY.md).
+    max_pool_reduce_window: bool = False
 
     # ------------------------------------------------------------------
     @property

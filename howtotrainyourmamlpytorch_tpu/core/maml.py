@@ -16,6 +16,7 @@ first-order MAML is a real option: ``stop_gradient`` on the inner grads.
 """
 
 import functools
+import warnings
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -24,7 +25,7 @@ import optax
 from jax import lax
 
 from ..config import Config
-from ..models import Model, build_model
+from ..models import Model, build_model, layers
 from ..ops import build_inner_optimizer
 from ..ops.losses import cross_entropy
 from ..ops.msl import final_step_only, per_step_loss_importance
@@ -98,8 +99,36 @@ class MAMLSystem:
         # step's whole dot/conv population); applied unconditionally so the
         # last-constructed system's config always wins and a 'high'/'highest'
         # from an earlier system in the same process can't silently leak into
-        # a later default-precision one
+        # a later default-precision one. Last-constructed-wins is itself a
+        # footgun for multi-system processes (probes, eval tooling), so any
+        # change of an already-set different value is warned loudly.
+        prev = jax.config.jax_default_matmul_precision
+        if prev is not None and prev != cfg.matmul_precision:
+            warnings.warn(
+                f"MAMLSystem(matmul_precision={cfg.matmul_precision!r}) is "
+                f"overriding the process-wide jax_default_matmul_precision "
+                f"({prev!r}); already-compiled programs keep the old value, "
+                f"anything traced from now on uses the new one",
+                stacklevel=2,
+            )
         jax.config.update("jax_default_matmul_precision", cfg.matmul_precision)
+        # same process-global pattern, same caveat: pooling tie-subgradient
+        # escape hatch for on-chip parity debugging (see layers.max_pool).
+        # The flag is read at trace time and is NOT part of the compiled-
+        # program cache key, so a change mid-process would contaminate any
+        # program another live system traces later — warn as loudly as the
+        # precision override above.
+        prev_pool = layers.FORCE_REDUCE_WINDOW_POOL
+        if prev_pool is not None and prev_pool != cfg.max_pool_reduce_window:
+            warnings.warn(
+                "MAMLSystem(max_pool_reduce_window="
+                f"{cfg.max_pool_reduce_window}) is flipping the process-wide "
+                f"pooling tie-subgradient escape hatch (was {prev_pool}); "
+                "programs traced from now on (including by OTHER live "
+                "systems) use the new convention",
+                stacklevel=2,
+            )
+        layers.FORCE_REDUCE_WINDOW_POOL = cfg.max_pool_reduce_window
 
         # Compiled program cache keyed by the static switches: (second_order,
         # msl_active). msl_active selects the rollout shape — per-step target

@@ -178,6 +178,19 @@ def batch_norm(
 # ---------------------------------------------------------------------------
 
 
+# Escape hatch for on-chip parity debugging: force the lax.reduce_window
+# path (select_and_scatter backward == torch's first-argmax tie subgradient)
+# even for non-overlapping pools. Set from Config.max_pool_reduce_window by
+# MAMLSystem.__init__; module-level because the model zoo calls
+# ``layers.max_pool`` directly. Trace-time static — baked into each compiled
+# program at trace time, so flip it before constructing the system. None =
+# not yet configured (treated as False); MAMLSystem warns when a system's
+# config flips an already-configured different value (the flag is not part
+# of any compile-cache key, so a mid-process flip changes what OTHER live
+# systems bake into programs they trace afterwards).
+FORCE_REDUCE_WINDOW_POOL = None
+
+
 def max_pool(x, window=2, stride=2):
     """MaxPool2d(window, stride, pad=0), floor mode — matches torch default.
 
@@ -190,10 +203,16 @@ def max_pool(x, window=2, stride=2):
     forward (DESIGN.md perf ledger). Deliberate subgradient difference: on a
     window with *tied* maxima the reshape path splits the gradient evenly
     among the ties where select_and_scatter (and torch) send it all to the
-    first argmax — both are valid subgradients; ties have measure zero in
-    f32 training and only matter under coarse quantization.
+    first argmax — both are valid subgradients. Ties have measure zero in
+    f32 training, BUT under bfloat16 compute (8-bit mantissa) tied window
+    maxima are plausible after quantization, so in the mixed-precision
+    regime this is a real gradient-level deviation from the reference's
+    torch convention. ``Config.max_pool_reduce_window=true`` (module flag
+    ``FORCE_REDUCE_WINDOW_POOL``) forces the reduce_window path so the
+    convention can be ruled in/out during on-chip parity debugging; see
+    PARITY.md.
     """
-    if window == stride:
+    if window == stride and not FORCE_REDUCE_WINDOW_POOL:
         b, h, w, c = x.shape
         ho, wo = h // window, w // window
         x = x[:, : ho * window, : wo * window, :]

@@ -1,7 +1,7 @@
 """Repeated-batch descent probe: can the full meta-step (second order, MSL,
-LSLR, outer Adam) descend on ONE fixed real 20-way batch?
+LSLR, outer Adam) descend on a small fixed set of real 20-way batches?
 
-Argv: [emulate 0/1] [n_way] [steps] [unroll 0/1, default 1]
+Argv: [emulate 0/1] [n_way] [steps] [unroll 0/1, default 1] [n_batches, default 1]
 
 `unroll=1` (default) compiles the SAME fully-unrolled second-order XLA
 program family the production sweep runs use (sweep.sh leaves
@@ -10,7 +10,13 @@ is about the platform's handling of that program. `unroll=0` is the rolled
 variant (used for CPU arms, where the unrolled graph compiles too slowly).
 `emulate=1` applies the shared bf16-operand MXU-default emulation from
 grad_precision_probe.py (CPU arms only).
-"""
+
+`n_batches>1` rotates the outer steps over that many DISTINCT fixed batches —
+the missing rung between the single repeated batch (descends fine on CPU
+under both precisions, r3) and the full stream (collapses on-chip, infeasible
+on CPU): if the collapse needs batch-to-batch variety to accumulate, K~8
+rotating batches can reproduce it off-chip in minutes. Reports per-step
+running train acc plus, at the end, train acc on every probe batch."""
 import os
 import sys
 
@@ -30,6 +36,7 @@ steps = int(sys.argv[3]) if len(sys.argv) > 3 else 25
 # slowly — default them to the rolled program; on-chip (emulate=0) arms
 # default to the production unrolled program. Explicit 4th arg wins.
 unroll = bool(int(sys.argv[4])) if len(sys.argv) > 4 else not emulate
+n_batches = int(sys.argv[5]) if len(sys.argv) > 5 else 1
 
 if emulate:
     from grad_precision_probe import apply_mxu_default_emulation
@@ -52,15 +59,42 @@ cfg = Config(
     remat_inner_steps=False,
 )
 loader = MetaLearningDataLoader(cfg, current_iter=0, data_root="/root/reference")
-batch = next(iter(loader.train_batches(1, augment_images=True)))
-batch = {k: jnp.asarray(v) for k, v in batch.items()}
+batches = []
+for b in loader.train_batches(n_batches, augment_images=True):
+    batches.append({k: jnp.asarray(v) for k, v in b.items()})
+    if len(batches) == n_batches:
+        break
 system = MAMLSystem(cfg)
+# Re-assert a JAX_DEFAULT_MATMUL_PRECISION env var AFTER construction: the
+# constructor applies cfg.matmul_precision ('default') process-wide, which
+# would silently downgrade a `JAX_DEFAULT_MATMUL_PRECISION=highest` probe arm.
+# Tracing happens at the first train_step call, so this wins (any valid JAX
+# spelling, not just the framework's three).
+_env_precision = os.environ.get("JAX_DEFAULT_MATMUL_PRECISION")
+if _env_precision:
+    jax.config.update("jax_default_matmul_precision", _env_precision)
 state = system.init_train_state()
 print(
-    f"emulate={emulate} n_way={n_way} unroll={unroll} backend={jax.default_backend()}",
+    f"emulate={emulate} n_way={n_way} unroll={unroll} n_batches={len(batches)} "
+    f"matmul_precision={jax.config.jax_default_matmul_precision or 'default'} "
+    f"backend={jax.default_backend()}",
     flush=True,
 )
 for i in range(steps):
-    state, out = system.train_step(state, batch, epoch=0)
+    state, out = system.train_step(state, batches[i % len(batches)], epoch=0)
     if i % 10 == 0 or i == steps - 1:
         print(f"step {i:3d} loss={float(out.loss):.4f} acc={float(out.accuracy):.4f}", flush=True)
+
+if len(batches) > 1:
+    # end-state train metrics on every probe batch (the step metrics above
+    # interleave batches, so per-batch end accuracy is the cleaner readout).
+    # train_step donates its state argument on-device (donate_argnums), so
+    # feed it a copy each time — the printed metrics are computed from the
+    # pre-update params, and the original end state stays alive for the next
+    # batch's readout.
+    accs = []
+    for j, b in enumerate(batches):
+        _, out = system.train_step(jax.tree.map(jnp.copy, state), b, epoch=0)
+        accs.append(float(out.accuracy))
+        print(f"final batch {j} loss={float(out.loss):.4f} acc={accs[-1]:.4f}", flush=True)
+    print(f"final mean acc over {len(batches)} batches: {sum(accs)/len(accs):.4f}", flush=True)

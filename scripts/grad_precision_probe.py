@@ -68,6 +68,16 @@ def meta_grads(n_way=20, k_shot=5, compute_dtype="float32"):
         compute_dtype=compute_dtype,
     )
     system = MAMLSystem(cfg)
+    # MAMLSystem.__init__ applies cfg.matmul_precision ('default') process-
+    # wide, which clobbers a JAX_DEFAULT_MATMUL_PRECISION env var set for a
+    # probe arm (JAX reads the env var once at import; the config update wins
+    # afterwards). Re-assert the env value AFTER construction — tracing only
+    # happens at the jit call below, so this is what the compiled program
+    # sees — and accept JAX's full value set (float32, tensorfloat32, ...),
+    # not just the three the framework config exposes.
+    env_precision = os.environ.get("JAX_DEFAULT_MATMUL_PRECISION")
+    if env_precision:
+        jax.config.update("jax_default_matmul_precision", env_precision)
     state = system.init_train_state()
     batch = {
         k: jnp.asarray(v)
@@ -103,7 +113,10 @@ def main():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     flat = meta_grads(n_way=n_way, compute_dtype=dtype)
-    print(f"backend={jax.default_backend()} n_way={n_way} dtype={dtype}")
+    print(
+        f"backend={jax.default_backend()} n_way={n_way} dtype={dtype} "
+        f"matmul_precision={jax.config.jax_default_matmul_precision or 'default'}"
+    )
     if mode == "save":
         np.savez(path, **flat)
         print(f"saved {len(flat)} grad tensors -> {path}")

@@ -8,38 +8,71 @@ burning watchdog restarts against a dead backend.
 Each probe is a separate python child (backend init happens once per
 process) killed on timeout. Exits 0 when a probe sees the TPU, 1 when the
 deadline passes.
+
+Also importable: ``wait_for_backend(...)`` is the single definition of
+"backend up" shared by this gate and bench.py, so the two can't drift on
+semantics like whether jax's silent CPU fallback counts (it does NOT,
+unless allow_cpu: a fast-erroring tunnel would otherwise pass the gate and
+launch a useless single-core run).
 """
 import subprocess
 import sys
 import time
 
-PROBE = "import jax; d = jax.devices(); print('TPU_OK', len(d), d[0].device_kind)"
+# The probe rejects the CPU fallback: when the tunneled plugin errors fast
+# (instead of hanging) jax falls back to the host CPU backend, which must not
+# count as the TPU being up.
+_PROBE_TPU = (
+    "import jax; d = jax.devices(); "
+    "assert d[0].platform != 'cpu', d; "
+    "print('BACKEND_OK', len(d), d[0].device_kind)"
+)
+_PROBE_ANY = "import jax; d = jax.devices(); print('BACKEND_OK', len(d), d[0].device_kind)"
 
 
-def main(deadline_s: float = 3600.0, probe_timeout_s: float = 90.0) -> int:
+def wait_for_backend(
+    deadline_s: float = 3600.0,
+    probe_timeout_s: float = 90.0,
+    allow_cpu: bool = False,
+    label: str = "wait_for_tpu",
+    log=print,
+) -> bool:
+    """Probe until a child process sees a non-CPU backend (or any backend,
+    with allow_cpu) or deadline_s passes. Returns True when up."""
+    probe = _PROBE_ANY if allow_cpu else _PROBE_TPU
     start = time.time()
     attempt = 0
     while time.time() - start < deadline_s:
         attempt += 1
+        diag = ""
         try:
             out = subprocess.run(
-                [sys.executable, "-c", PROBE],
+                [sys.executable, "-c", probe],
                 timeout=probe_timeout_s,
                 capture_output=True,
                 text=True,
             )
-            if "TPU_OK" in out.stdout:
-                print(f"wait_for_tpu: backend up after {time.time()-start:.0f}s "
-                      f"({attempt} probes): {out.stdout.strip().splitlines()[-1]}",
-                      flush=True)
-                return 0
+            if "BACKEND_OK" in out.stdout:
+                log(
+                    f"{label}: backend up after {time.time()-start:.0f}s "
+                    f"({attempt} probes): {out.stdout.strip().splitlines()[-1]}"
+                )
+                return True
+            diag = f"rc={out.returncode} stderr: ...{out.stderr.strip()[-200:]}"
         except subprocess.TimeoutExpired:
-            pass
-        print(f"wait_for_tpu: probe {attempt} failed ({time.time()-start:.0f}s elapsed)",
-              flush=True)
-        time.sleep(30)
-    print("wait_for_tpu: deadline exceeded", flush=True)
-    return 1
+            diag = f"hung >{probe_timeout_s:.0f}s (wedged tunnel)"
+        elapsed = time.time() - start
+        log(f"{label}: probe {attempt} failed ({elapsed:.0f}s elapsed): {diag}")
+        time.sleep(min(30.0, max(0.0, deadline_s - elapsed)))
+    log(f"{label}: deadline exceeded")
+    return False
+
+
+def main(deadline_s: float = 3600.0, probe_timeout_s: float = 90.0) -> int:
+    def log(msg):
+        print(msg, flush=True)
+
+    return 0 if wait_for_backend(deadline_s, probe_timeout_s, log=log) else 1
 
 
 if __name__ == "__main__":

@@ -184,3 +184,41 @@ def test_max_pool_tie_subgradient_convention():
     x_all_tied = jnp.ones((1, 2, 2, 1), np.float32)
     g2 = jax.grad(lambda a: jnp.sum(layers.max_pool(a)))(x_all_tied)
     np.testing.assert_allclose(np.asarray(g2), 0.25 * np.ones((1, 2, 2, 1)))
+
+
+def test_max_pool_reduce_window_escape_hatch():
+    """Config.max_pool_reduce_window forces the reduce_window path, whose
+    select_and_scatter backward uses torch's first-argmax tie subgradient —
+    the escape hatch for ruling the pooling convention in/out under bf16
+    quantization (ADVICE r3; max_pool docstring)."""
+    from howtotrainyourmamlpytorch_tpu.config import Config
+
+    x_all_tied = jnp.ones((1, 2, 2, 1), np.float32)
+    prev = layers.FORCE_REDUCE_WINDOW_POOL
+    try:
+        layers.FORCE_REDUCE_WINDOW_POOL = True
+        g = jax.grad(lambda a: jnp.sum(layers.max_pool(a)))(x_all_tied)
+        expected = np.zeros((1, 2, 2, 1), np.float32)
+        expected[0, 0, 0, 0] = 1.0  # all gradient to the first argmax
+        np.testing.assert_allclose(np.asarray(g), expected)
+        # tie-free forward unchanged
+        rng = np.random.RandomState(0)
+        xc = jnp.asarray(rng.randn(1, 8, 8, 2).astype(np.float32))
+        forced = layers.max_pool(xc)
+        layers.FORCE_REDUCE_WINDOW_POOL = False
+        np.testing.assert_allclose(forced, layers.max_pool(xc), rtol=0, atol=0)
+    finally:
+        layers.FORCE_REDUCE_WINDOW_POOL = prev
+
+    # config knob threads through to the module flag at system construction
+    from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+
+    try:
+        layers.FORCE_REDUCE_WINDOW_POOL = False  # an already-configured process
+        with pytest.warns(UserWarning, match="tie-subgradient"):
+            # flipping a configured value mid-process must warn (the flag is
+            # not in any compile-cache key — convention-change guard)
+            MAMLSystem(Config(max_pool_reduce_window=True))
+        assert layers.FORCE_REDUCE_WINDOW_POOL is True
+    finally:
+        layers.FORCE_REDUCE_WINDOW_POOL = prev
