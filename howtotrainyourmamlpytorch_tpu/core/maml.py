@@ -16,6 +16,7 @@ first-order MAML is a real option: ``stop_gradient`` on the inner grads.
 """
 
 import functools
+import os
 import warnings
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
@@ -99,19 +100,34 @@ class MAMLSystem:
         # step's whole dot/conv population); applied unconditionally so the
         # last-constructed system's config always wins and a 'high'/'highest'
         # from an earlier system in the same process can't silently leak into
-        # a later default-precision one. Last-constructed-wins is itself a
-        # footgun for multi-system processes (probes, eval tooling), so any
-        # change of an already-set different value is warned loudly.
-        prev = jax.config.jax_default_matmul_precision
-        if prev is not None and prev != cfg.matmul_precision:
+        # a later default-precision one. Exception: an explicit
+        # JAX_DEFAULT_MATMUL_PRECISION env var wins over the config — it is
+        # the documented jax contract and the probe scripts' A/B lever, and
+        # the constructor silently clobbering it mislabeled a round-3
+        # precision-probe arm (ADVICE r3). Env values may be any valid jax
+        # spelling (float32, tensorfloat32, ...), wider than the three this
+        # config validates. Last-constructed-wins is itself a footgun for
+        # multi-system processes (probes, eval tooling), so any change of an
+        # already-set different value is warned loudly.
+        env_precision = os.environ.get("JAX_DEFAULT_MATMUL_PRECISION")
+        target_precision = env_precision or cfg.matmul_precision
+        if env_precision and env_precision != cfg.matmul_precision:
             warnings.warn(
-                f"MAMLSystem(matmul_precision={cfg.matmul_precision!r}) is "
+                f"JAX_DEFAULT_MATMUL_PRECISION={env_precision!r} overrides "
+                f"Config.matmul_precision={cfg.matmul_precision!r} for this "
+                "process (env var wins; unset it to use the config value)",
+                stacklevel=2,
+            )
+        prev = jax.config.jax_default_matmul_precision
+        if prev is not None and prev != target_precision:
+            warnings.warn(
+                f"MAMLSystem(matmul_precision={target_precision!r}) is "
                 f"overriding the process-wide jax_default_matmul_precision "
                 f"({prev!r}); already-compiled programs keep the old value, "
                 f"anything traced from now on uses the new one",
                 stacklevel=2,
             )
-        jax.config.update("jax_default_matmul_precision", cfg.matmul_precision)
+        jax.config.update("jax_default_matmul_precision", target_precision)
         # same process-global pattern, same caveat: pooling tie-subgradient
         # escape hatch for on-chip parity debugging (see layers.max_pool).
         # The flag is read at trace time and is NOT part of the compiled-

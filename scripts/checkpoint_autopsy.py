@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Autopsy of a (collapsed) run's saved TrainState checkpoints, entirely on
+CPU — no tunnel needed.
+
+The round-3 20-way on-chip collapse left epoch-9..13 checkpoints behind
+(exps/omniglot.20.5.vgg.gd.s0). This script loads them and answers the
+discriminating question the chip can't be asked while the tunnel is down:
+
+  **Does the collapsed state fail on CPU too?**
+
+- If CPU inner-adaptation from the checkpointed params also scores ~chance,
+  the *state itself* is destroyed — the on-chip outer updates walked it
+  somewhere unrecoverable (training-dynamics / platform-computed-update
+  issue, but a real state, faithfully saved).
+- If CPU adaptation from the same state scores well, the chip's *execution*
+  of the adaptation/eval program is numerically wrong (platform bug), since
+  the identical program on the identical state gives different answers.
+
+Also dumps per-tensor param/BN/Adam-moment/LSLR statistics per checkpoint to
+show *what* degraded and when.
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/checkpoint_autopsy.py <run_dir> [epoch ...]
+  (defaults: all available epochs + 'best'; eval on 3 real val batches)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.config import load_config
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data import MetaLearningDataLoader
+from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+
+def tensor_stats(tree, label):
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    print(f"  {label}:")
+    for path, leaf in leaves:
+        a = np.asarray(leaf, np.float64)
+        name = jax.tree_util.keystr(path)
+        print(
+            f"    {name:55s} shape={str(a.shape):18s} "
+            f"|x|={np.linalg.norm(a):10.3e} max|x|={np.abs(a).max():10.3e} "
+            f"mean={a.mean():+9.3e}"
+        )
+
+
+def main():
+    run_dir = sys.argv[1]
+    save_dir = os.path.join(run_dir, "saved_models")
+    idxs = sys.argv[2:] or [str(e) for e in ckpt.available_epochs(save_dir)] + ["best"]
+
+    import dataclasses
+
+    cfg = load_config(os.path.join(run_dir, "config.yaml"))
+    # CPU-friendly program family: rolled scan compiles fast; math identical
+    # (rolled-vs-unrolled parity is pinned by tests/test_maml_core.py). Point
+    # the dataset at the read-only reference copy regardless of what the
+    # run dir recorded.
+    cfg = dataclasses.replace(
+        cfg,
+        unroll_inner_steps=False,
+        remat_inner_steps=True,
+        load_into_memory=False,
+        index_cache_dir="/tmp/omniglot_idx",
+    )
+    system = MAMLSystem(cfg)
+    template = system.init_train_state()
+
+    loader = MetaLearningDataLoader(cfg, current_iter=0, data_root="/root/reference")
+    n_eval_batches = int(os.environ.get("AUTOPSY_EVAL_BATCHES", "3"))
+    batches = []
+    for b in loader.val_batches(n_eval_batches):
+        batches.append({k: jnp.asarray(v) for k, v in b.items()})
+        if len(batches) == n_eval_batches:
+            break
+
+    for idx in idxs:
+        if not ckpt.checkpoint_exists(save_dir, idx):
+            print(f"== checkpoint {idx}: missing, skipped")
+            continue
+        state, book = ckpt.load_checkpoint(save_dir, idx, template)
+        print(f"== checkpoint {idx} (epoch={book.get('epoch')}, step={int(state.step)})")
+        tensor_stats(state.params, "params")
+        tensor_stats(state.bn_state, "bn_state")
+        if state.inner_hparams:
+            tensor_stats(state.inner_hparams, "inner_hparams (learned lrs)")
+        losses, accs = [], []
+        for b in batches:
+            out = system.eval_step(state, b)
+            losses.append(float(out.loss))
+            accs.append(float(out.accuracy))
+        print(
+            f"  CPU eval ({len(batches)} real val batches, "
+            f"{cfg.number_of_evaluation_steps_per_iter} inner steps): "
+            f"loss={np.mean(losses):.4f} acc={np.mean(accs):.4f} "
+            f"(per-batch acc: {', '.join(f'{a:.3f}' for a in accs)})"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -64,15 +64,7 @@ for b in loader.train_batches(n_batches, augment_images=True):
     batches.append({k: jnp.asarray(v) for k, v in b.items()})
     if len(batches) == n_batches:
         break
-system = MAMLSystem(cfg)
-# Re-assert a JAX_DEFAULT_MATMUL_PRECISION env var AFTER construction: the
-# constructor applies cfg.matmul_precision ('default') process-wide, which
-# would silently downgrade a `JAX_DEFAULT_MATMUL_PRECISION=highest` probe arm.
-# Tracing happens at the first train_step call, so this wins (any valid JAX
-# spelling, not just the framework's three).
-_env_precision = os.environ.get("JAX_DEFAULT_MATMUL_PRECISION")
-if _env_precision:
-    jax.config.update("jax_default_matmul_precision", _env_precision)
+system = MAMLSystem(cfg)  # honors JAX_DEFAULT_MATMUL_PRECISION (env wins)
 state = system.init_train_state()
 print(
     f"emulate={emulate} n_way={n_way} unroll={unroll} n_batches={len(batches)} "

@@ -67,17 +67,9 @@ def meta_grads(n_way=20, k_shot=5, compute_dtype="float32"):
         num_samples_per_class=k_shot,
         compute_dtype=compute_dtype,
     )
+    # MAMLSystem honors JAX_DEFAULT_MATMUL_PRECISION (env var wins over the
+    # config, any valid jax spelling) — the documented probe-arm lever.
     system = MAMLSystem(cfg)
-    # MAMLSystem.__init__ applies cfg.matmul_precision ('default') process-
-    # wide, which clobbers a JAX_DEFAULT_MATMUL_PRECISION env var set for a
-    # probe arm (JAX reads the env var once at import; the config update wins
-    # afterwards). Re-assert the env value AFTER construction — tracing only
-    # happens at the jit call below, so this is what the compiled program
-    # sees — and accept JAX's full value set (float32, tensorfloat32, ...),
-    # not just the three the framework config exposes.
-    env_precision = os.environ.get("JAX_DEFAULT_MATMUL_PRECISION")
-    if env_precision:
-        jax.config.update("jax_default_matmul_precision", env_precision)
     state = system.init_train_state()
     batch = {
         k: jnp.asarray(v)

@@ -1,0 +1,66 @@
+#!/bin/bash
+# Round-4 chip-work queue: serialize everything that needs the single
+# tunneled chip, in priority order, fully unattended (the tunnel wedges for
+# hours; whenever it answers, this drains the queue):
+#   1. wait for the armed 20-way diag chain (scripts/diag_chain.sh) to finish
+#   2. capture the round-4 bench number (bench.py now waits out wedges itself)
+#   3. run the accuracy-matrix sweep rows (VERDICT r3 item 3 priority order)
+# The 20-way full-budget runs are NOT queued here: they need the diag
+# verdict to pick the fix; the operator kills the sweep (runs resume exactly)
+# and runs them once the chain reports.
+#
+# Usage: scripts/round4_queue.sh <diag_chain_pid> [deadline_epoch]
+set -u
+cd /root/repo
+CHAIN_PID=${1:-}
+LOG=exps/round4_queue.log
+mkdir -p exps
+echo "=== $(date -u +%H:%M:%S) queue start (waiting on diag chain pid=${CHAIN_PID})" >> "$LOG"
+
+# guard against PID recycling: only wait while the pid is alive AND still
+# the diag chain (a recycled pid for some other long-lived process would
+# otherwise park the queue forever)
+if [ -n "$CHAIN_PID" ]; then
+  while kill -0 "$CHAIN_PID" 2>/dev/null \
+      && grep -aq diag_chain "/proc/$CHAIN_PID/cmdline" 2>/dev/null; do
+    sleep 60
+  done
+fi
+echo "=== $(date -u +%H:%M:%S) diag chain done; running bench" >> "$LOG"
+
+BENCH_STARTUP_DEADLINE_S=7200 timeout --kill-after=30 9000 \
+  python bench.py > exps/bench_r04.json 2> exps/bench_r04.err
+rc=$?
+# exps/ is gitignored and wiped on container resets (this exact loss mode
+# cost round 3 its bench number) — copy the capture somewhere durable
+# immediately
+mkdir -p results/r4
+cp -f exps/bench_r04.json results/r4/bench_r04_capture.json 2>/dev/null
+tail -c 4096 exps/bench_r04.err > results/r4/bench_r04_capture.err 2>/dev/null
+echo "=== $(date -u +%H:%M:%S) bench rc=$rc -> exps/bench_r04.json (+ results/r4/)" >> "$LOG"
+
+# ~1h/row full-budget; DEADLINE_EPOCH (exported to sweep.sh) stops starting
+# rows that would overrun the round.
+export DEADLINE_EPOCH=${2:-$(( $(date +%s) + 9 * 3600 ))}
+# Config defaults are the reference's 20-way 5-shot — every row must pin
+# its own n_way/k_shot explicitly.
+W5S1="num_classes_per_set=5 num_samples_per_class=1"
+W5S5="num_classes_per_set=5 num_samples_per_class=5"
+bash scripts/sweep.sh \
+  "omniglot.5.1.resnet-4.gd.0 $W5S1 net=resnet-4" \
+  "omniglot.5.1.vgg.adam.0 $W5S1 inner_optim=adam" \
+  "omniglot.5.1.vgg.gd.1 $W5S1 seed=1 train_seed=1 val_seed=1" \
+  "omniglot.5.5.vgg.gd.1 $W5S5 seed=1 train_seed=1 val_seed=1" \
+  "omniglot.5.5.densenet-8.gd.0 $W5S5 net=densenet-8" \
+  "omniglot.5.1.vgg.gd.2 $W5S1 seed=2 train_seed=2 val_seed=2" \
+  "omniglot.5.5.vgg.gd.2 $W5S5 seed=2 train_seed=2 val_seed=2" \
+  >> "$LOG" 2>&1
+# durable copy of run artifacts (not checkpoints) for every finished row
+for d in exps/omniglot.*; do
+  [ -d "$d/logs" ] || continue
+  name=$(basename "$d")
+  mkdir -p "results/r4/$name"
+  cp -f "$d"/logs/*.csv "$d"/logs/*.json "$d"/lrs.csv "$d"/betas.csv \
+    "$d"/config.yaml "results/r4/$name/" 2>/dev/null
+done
+echo "=== $(date -u +%H:%M:%S) queue done (artifacts copied to results/r4/)" >> "$LOG"

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Replay the EXACT training stream a real-chip run saw, on CPU, from one of
+its own checkpoints — the controlled A/B the tunnel can't block.
+
+The episode stream is a pure function of (train_seed, cursor), and the
+checkpoint bookkeeping stores the cursor, so from checkpoint N this replays
+the same batches the chip consumed after epoch N (same augmentations, same
+order). If the chip's run degraded over these steps while this CPU replay
+from the identical state+stream holds or improves, the chip's computed
+updates are numerically wrong (platform); if CPU degrades the same way, the
+collapse is real training dynamics (framework).
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/stream_replay_probe.py <run_dir> <ckpt_idx> <n_steps> [print_every]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.config import load_config
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data import MetaLearningDataLoader
+from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+
+
+def main():
+    run_dir, idx, n_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    print_every = int(sys.argv[4]) if len(sys.argv) > 4 else 10
+
+    cfg = load_config(os.path.join(run_dir, "config.yaml"))
+    cfg = dataclasses.replace(
+        cfg,
+        unroll_inner_steps=False,  # CPU-compilable program; math parity tested
+        remat_inner_steps=True,
+        load_into_memory=False,
+        index_cache_dir="/tmp/omniglot_idx",
+    )
+    system = MAMLSystem(cfg)
+    state, book = ckpt.load_checkpoint(
+        os.path.join(run_dir, "saved_models"), idx, system.init_train_state()
+    )
+    epoch = int(book.get("epoch", 0))
+    cursor = int(book.get("train_episodes_produced", 0))
+    # the runner resumes the stream at the NEXT epoch boundary
+    next_epoch = epoch + 1
+    loader = MetaLearningDataLoader(
+        cfg,
+        current_iter=next_epoch * cfg.total_iter_per_epoch,
+        data_root="/root/reference",
+    )
+    print(
+        f"replay from ckpt {idx}: epoch={epoch} step={int(state.step)} "
+        f"cursor={cursor} -> replaying epoch {next_epoch} stream on "
+        f"{jax.default_backend()}",
+        flush=True,
+    )
+    it = loader.train_batches(n_steps, augment_images=True)
+    for i, b in enumerate(it):
+        if i >= n_steps:
+            break
+        b = {k: jnp.asarray(v) for k, v in b.items()}
+        state, out = system.train_step(state, b, epoch=next_epoch)
+        if i % print_every == 0 or i == n_steps - 1:
+            print(
+                f"step {i:4d} loss={float(out.loss):.4f} "
+                f"acc={float(out.accuracy):.4f}",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -113,3 +113,25 @@ def test_matmul_precision_knob():
         assert jax.config.jax_default_matmul_precision == "high"
     finally:
         jax.config.update("jax_default_matmul_precision", before)
+
+
+def test_matmul_precision_env_var_wins(monkeypatch):
+    """An explicit JAX_DEFAULT_MATMUL_PRECISION env var beats the config at
+    MAMLSystem construction — the documented jax contract and the probe
+    scripts' A/B lever; the constructor silently clobbering it mislabeled a
+    round-3 precision-probe arm (ADVICE r3). Any valid jax spelling is
+    honored, not just the three the config validates."""
+    import jax
+    import pytest
+
+    from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+
+    before = jax.config.jax_default_matmul_precision
+    monkeypatch.setenv("JAX_DEFAULT_MATMUL_PRECISION", "float32")
+    try:
+        with pytest.warns(UserWarning, match="env var wins"):
+            MAMLSystem(Config(matmul_precision="high", num_classes_per_set=3,
+                              num_samples_per_class=1))
+        assert jax.config.jax_default_matmul_precision == "float32"
+    finally:
+        jax.config.update("jax_default_matmul_precision", before)
