@@ -11,7 +11,15 @@ updates are numerically wrong (platform); if CPU degrades the same way, the
 collapse is real training dynamics (framework).
 
 Usage:
-  JAX_PLATFORMS=cpu python scripts/stream_replay_probe.py <run_dir> <ckpt_idx> <n_steps> [print_every]
+  JAX_PLATFORMS=cpu python scripts/stream_replay_probe.py <run_dir> <ckpt_idx> <n_steps> [print_every] [emulate 0/1]
+
+`emulate=1` applies the shared bf16-operand MXU-default emulation
+(grad_precision_probe.apply_mxu_default_emulation) — the second arm of the
+off-chip A/B: if the f32 replay holds but the emulated replay collapses the
+way the chip did, the collapse is *precision dynamics over the varied
+stream* (fix: matmul_precision=high for hard configs); if both hold, the
+chip's divergence is a genuine platform execution bug (donation aliasing &
+co — the on-chip diag chain discriminates further).
 """
 import os
 import sys
@@ -37,6 +45,13 @@ from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
 def main():
     run_dir, idx, n_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
     print_every = int(sys.argv[4]) if len(sys.argv) > 4 else 10
+    emulate = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+
+    if emulate:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from grad_precision_probe import apply_mxu_default_emulation
+
+        apply_mxu_default_emulation()
 
     cfg = load_config(os.path.join(run_dir, "config.yaml"))
     cfg = dataclasses.replace(
@@ -61,8 +76,8 @@ def main():
     )
     print(
         f"replay from ckpt {idx}: epoch={epoch} step={int(state.step)} "
-        f"cursor={cursor} -> replaying epoch {next_epoch} stream on "
-        f"{jax.default_backend()}",
+        f"cursor={cursor} emulate={emulate} -> replaying epoch {next_epoch} "
+        f"stream on {jax.default_backend()}",
         flush=True,
     )
     it = loader.train_batches(n_steps, augment_images=True)
