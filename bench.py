@@ -165,7 +165,14 @@ def main():
     # NOT in this recipe: measured head-to-head on the real chip it is ~1%
     # slower than XLA's own fusion of the inner update at this model size
     # (22.11 vs 22.28 steps/s), so it stays an opt-in feature.
-    cfg = Config(compute_dtype="bfloat16", remat_inner_steps=False)
+    # BENCH_MATMUL_PRECISION quantifies the throughput cost of raising MXU
+    # precision (the 20-way-collapse fix candidate runs f32 configs at
+    # 'high'): same flagship program, different dot/conv pass count.
+    cfg = Config(
+        compute_dtype="bfloat16",
+        remat_inner_steps=False,
+        matmul_precision=os.environ.get("BENCH_MATMUL_PRECISION", "default"),
+    )
     system = MAMLSystem(cfg)
     state = system.init_train_state()
     batch = {

@@ -39,6 +39,14 @@ cp -f exps/bench_r04.json results/r4/bench_r04_capture.json 2>/dev/null
 tail -c 4096 exps/bench_r04.err > results/r4/bench_r04_capture.err 2>/dev/null
 echo "=== $(date -u +%H:%M:%S) bench rc=$rc -> exps/bench_r04.json (+ results/r4/)" >> "$LOG"
 
+# throughput cost of the 20-way fix candidate (f32-quality matmuls): same
+# flagship program at matmul_precision=high
+BENCH_MATMUL_PRECISION=high BENCH_STARTUP_DEADLINE_S=3600 \
+  timeout --kill-after=30 6000 \
+  python bench.py > exps/bench_r04_high.json 2> exps/bench_r04_high.err
+cp -f exps/bench_r04_high.json results/r4/bench_r04_high.json 2>/dev/null
+echo "=== $(date -u +%H:%M:%S) bench(high) rc=$? -> results/r4/bench_r04_high.json" >> "$LOG"
+
 # ~1h/row full-budget; DEADLINE_EPOCH (exported to sweep.sh) stops starting
 # rows that would overrun the round.
 export DEADLINE_EPOCH=${2:-$(( $(date +%s) + 9 * 3600 ))}
@@ -63,4 +71,6 @@ for d in exps/omniglot.*; do
   cp -f "$d"/logs/*.csv "$d"/logs/*.json "$d"/lrs.csv "$d"/betas.csv \
     "$d"/config.yaml "results/r4/$name/" 2>/dev/null
 done
+# regenerate the aggregated accuracy report from everything that finished
+python analyze_results.py exps/ --out results/r4/analysis >> "$LOG" 2>&1
 echo "=== $(date -u +%H:%M:%S) queue done (artifacts copied to results/r4/)" >> "$LOG"
