@@ -1,0 +1,29 @@
+#!/bin/bash
+# Serial CPU replay arms for the 20-way collapse A/B (one core — serialize).
+# Arm order (persistent compile cache makes arms 2-4 start fast):
+#   1. f32 from INIT over the epoch-0 stream (the decisive framework-
+#      dynamics test: chip recorded epoch-0 mean 18.6% with fast decay)
+#   2. MXU-default emulation from INIT (precision-dynamics test)
+#   3. f32 from best (does the stream from the partially-damaged epoch-0
+#      state recover or keep sinking under healthy updates?)
+#   4. MXU-default emulation from best
+set -u
+cd /root/repo
+RUN=exps/omniglot.20.5.vgg.gd.s0
+
+JAX_PLATFORMS=cpu timeout --kill-after=30 14400 \
+  python -u scripts/stream_replay_probe.py "$RUN" init 150 5 0 \
+  > exps/diag/stream_replay_init_f32.log 2>&1
+JAX_PLATFORMS=cpu timeout --kill-after=30 14400 \
+  python -u scripts/stream_replay_probe.py "$RUN" init 150 5 1 \
+  > exps/diag/stream_replay_init_emu.log 2>&1
+JAX_PLATFORMS=cpu timeout --kill-after=30 14400 \
+  python -u scripts/stream_replay_probe.py "$RUN" best 150 5 0 \
+  > exps/diag/stream_replay_best.log 2>&1
+JAX_PLATFORMS=cpu timeout --kill-after=30 14400 \
+  python -u scripts/stream_replay_probe.py "$RUN" best 150 5 1 \
+  > exps/diag/stream_replay_best_emu.log 2>&1
+# durable copies (exps/ is wiped on container resets)
+mkdir -p results/r4
+cp -f exps/diag/stream_replay_*.log exps/diag/autopsy_20w.log results/r4/ 2>/dev/null
+echo "replay chain done $(date -u +%H:%M:%S)"

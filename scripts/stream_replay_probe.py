@@ -30,6 +30,13 @@ import jax
 
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+# persistent compile cache: the probe arms re-trace the same program family
+# (per emulation arm), and CPU compiles of the 20-way program cost 10-20 min
+if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.expanduser("~"), ".cache", "htymp_tpu_xla"),
+    )
 
 import dataclasses
 
@@ -62,11 +69,19 @@ def main():
         index_cache_dir="/tmp/omniglot_idx",
     )
     system = MAMLSystem(cfg)
-    state, book = ckpt.load_checkpoint(
-        os.path.join(run_dir, "saved_models"), idx, system.init_train_state()
-    )
-    epoch = int(book.get("epoch", 0))
-    cursor = int(book.get("train_episodes_produced", 0))
+    if idx == "init":
+        # replay from the run's own initialization (same seed) over the
+        # epoch-0 stream — the chip's recorded epoch-0 mean is the comparand;
+        # this arm exists because destruction may begin within epoch 0,
+        # leaving no clean saved state to start from
+        state = system.init_train_state()
+        epoch, cursor = -1, 0
+    else:
+        state, book = ckpt.load_checkpoint(
+            os.path.join(run_dir, "saved_models"), idx, system.init_train_state()
+        )
+        epoch = int(book.get("epoch", 0))
+        cursor = int(book.get("train_episodes_produced", 0))
     # the runner resumes the stream at the NEXT epoch boundary
     next_epoch = epoch + 1
     loader = MetaLearningDataLoader(
