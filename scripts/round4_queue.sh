@@ -26,6 +26,15 @@ if [ -n "$CHAIN_PID" ]; then
     sleep 60
   done
 fi
+# The chain aborts (without running anything) if its first tunnel gate times
+# out after 5h. The diagnostics are the round's most valuable chip work, so
+# give the chain one more full-gate window before conceding the chip to
+# bench+sweep.
+if ! grep -q "diag chain done" exps/diag/chain.log 2>/dev/null; then
+  echo "=== $(date -u +%H:%M:%S) diag chain incomplete, re-running it" >> "$LOG"
+  bash scripts/diag_chain.sh
+fi
+cp -f exps/diag/chain.log results/r4/diag_chain.log 2>/dev/null
 echo "=== $(date -u +%H:%M:%S) diag chain done; running bench" >> "$LOG"
 
 BENCH_STARTUP_DEADLINE_S=7200 timeout --kill-after=30 9000 \
