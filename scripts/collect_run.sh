@@ -1,6 +1,6 @@
 #!/bin/bash
 # Copy a finished run's artifacts from the (gitignored) exps/ tree into
-# results/r3/<name>/ for commit. Checkpoints stay behind (size); everything
+# results/<round>/<name>/ (default r4) for commit. Checkpoints stay behind (size); everything
 # the analysis pipeline reads (config.yaml, logs/*.csv, events.jsonl,
 # lrs.csv/betas.csv) comes along. Round-3 lesson: a completed run whose
 # artifacts only live in exps/ dies with the container — collect and commit
@@ -8,8 +8,9 @@
 set -eu
 cd /root/repo
 name=$1
+round=${2:-r4}
 src="exps/$name"
-dst="results/r3/$name"
+dst="results/$round/$name"
 [ -d "$src" ] || { echo "no such run dir: $src" >&2; exit 1; }
 rm -rf "$dst"   # re-collection replaces; cp -r into an existing dir would nest logs/logs
 mkdir -p "$dst"
