@@ -61,17 +61,52 @@ echo "=== $(date -u +%H:%M:%S) bench(high) rc=$? -> results/r4/bench_r04_high.js
 export DEADLINE_EPOCH=${2:-$(( $(date +%s) + 9 * 3600 ))}
 # Config defaults are the reference's 20-way 5-shot — every row must pin
 # its own n_way/k_shot explicitly.
+#
+# The two 20-way donation-off rows lead: round-4 CPU evidence (fresh-stream
+# probes healthy at f32 AND under MXU-default emulation, results/r4/
+# DIAG_20way_r4.md) isolates the on-chip collapse to platform execution,
+# with jit buffer donation the top suspect (ignored on CPU, matches the
+# cumulative-corruption signature). If donation is it, these rows are
+# simultaneously the fix verification and the missing 20-way parity rows
+# (ref 99.13±0.13 / 97.21±0.11).
 W5S1="num_classes_per_set=5 num_samples_per_class=1"
 W5S5="num_classes_per_set=5 num_samples_per_class=5"
-bash scripts/sweep.sh \
-  "omniglot.5.1.resnet-4.gd.0 $W5S1 net=resnet-4" \
-  "omniglot.5.1.vgg.adam.0 $W5S1 inner_optim=adam" \
-  "omniglot.5.1.vgg.gd.1 $W5S1 seed=1 train_seed=1 val_seed=1" \
-  "omniglot.5.5.vgg.gd.1 $W5S5 seed=1 train_seed=1 val_seed=1" \
-  "omniglot.5.5.densenet-8.gd.0 $W5S5 net=densenet-8" \
-  "omniglot.5.1.vgg.gd.2 $W5S1 seed=2 train_seed=2 val_seed=2" \
-  "omniglot.5.5.vgg.gd.2 $W5S5 seed=2 train_seed=2 val_seed=2" \
-  >> "$LOG" 2>&1
+W20S1="num_classes_per_set=20 num_samples_per_class=1"
+W20S5="num_classes_per_set=20 num_samples_per_class=5"
+NODONATE5="omniglot.20.5.vgg.gd.nodonate.0 $W20S5 donate_train_state=false"
+NODONATE1="omniglot.20.1.vgg.gd.nodonate.0 $W20S1 donate_train_state=false"
+# If the chain's X8 arm (3-epoch 20w5s donation-off) already ran and STILL
+# collapsed (epoch-2 train acc <= 0.25), donation isn't the fix — demote the
+# full-budget nodonate rows behind the guaranteed-value 5-way rows. The
+# first 'epoch 2:' line in chain.log is X8's (the probe arms before it
+# print no epoch lines).
+x8_acc=$(grep -oE 'epoch 2: train_acc=[0-9.]+' exps/diag/chain.log 2>/dev/null \
+  | head -1 | grep -oE '[0-9.]+$')
+if [ -n "$x8_acc" ] && awk "BEGIN{exit !($x8_acc <= 0.25)}"; then
+  echo "=== X8 donation-off arm collapsed too (epoch-2 acc $x8_acc) — demoting nodonate rows" >> "$LOG"
+  set -- \
+    "omniglot.5.1.resnet-4.gd.0 $W5S1 net=resnet-4" \
+    "omniglot.5.1.vgg.adam.0 $W5S1 inner_optim=adam" \
+    "omniglot.5.1.vgg.gd.1 $W5S1 seed=1 train_seed=1 val_seed=1" \
+    "omniglot.5.5.vgg.gd.1 $W5S5 seed=1 train_seed=1 val_seed=1" \
+    "omniglot.5.5.densenet-8.gd.0 $W5S5 net=densenet-8" \
+    "omniglot.5.1.vgg.gd.2 $W5S1 seed=2 train_seed=2 val_seed=2" \
+    "omniglot.5.5.vgg.gd.2 $W5S5 seed=2 train_seed=2 val_seed=2" \
+    "$NODONATE5" \
+    "$NODONATE1"
+else
+  set -- \
+    "$NODONATE5" \
+    "$NODONATE1" \
+    "omniglot.5.1.resnet-4.gd.0 $W5S1 net=resnet-4" \
+    "omniglot.5.1.vgg.adam.0 $W5S1 inner_optim=adam" \
+    "omniglot.5.1.vgg.gd.1 $W5S1 seed=1 train_seed=1 val_seed=1" \
+    "omniglot.5.5.vgg.gd.1 $W5S5 seed=1 train_seed=1 val_seed=1" \
+    "omniglot.5.5.densenet-8.gd.0 $W5S5 net=densenet-8" \
+    "omniglot.5.1.vgg.gd.2 $W5S1 seed=2 train_seed=2 val_seed=2" \
+    "omniglot.5.5.vgg.gd.2 $W5S5 seed=2 train_seed=2 val_seed=2"
+fi
+bash scripts/sweep.sh "$@" >> "$LOG" 2>&1
 # durable copy of run artifacts (not checkpoints) for every finished row
 for d in exps/omniglot.*; do
   [ -d "$d/logs" ] || continue
