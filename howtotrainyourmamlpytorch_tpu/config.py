@@ -238,6 +238,13 @@ class Config:
     # pooling convention in/out during on-chip mixed-precision parity
     # debugging (see models/layers.py max_pool docstring, PARITY.md).
     max_pool_reduce_window: bool = False
+    # Early divergence abort (sweep-time guard; 0.0 disables): exit with
+    # code 3 when train accuracy is still below this after
+    # ``early_abort_epoch`` epochs — a collapsing run (the on-chip 20-way
+    # failure mode) should release the chip instead of burning its full
+    # budget. scripts/sweep.sh treats rc=3 as permanent, not retryable.
+    early_abort_train_acc: float = 0.0
+    early_abort_epoch: int = 3
 
     # ------------------------------------------------------------------
     @property

@@ -410,6 +410,31 @@ class ExperimentRunner:
                 f"val_acc={stats['val_accuracy_mean']:.4f} "
                 f"({stats['epoch_run_time']:.1f}s)"
             )
+            # Early divergence abort (no reference equivalent — sweep-time
+            # guard): a run whose train accuracy is still below the
+            # threshold after the grace window is collapsing (e.g. the
+            # on-chip 20-way failure mode, DIAG_20way); exit with the
+            # distinct code 3 so harnesses (scripts/sweep.sh) fail it
+            # permanently instead of burning watchdog restarts on a doomed
+            # full-budget run. Checkpoints up to this epoch remain on disk.
+            if (
+                cfg.early_abort_train_acc > 0.0
+                and epoch >= cfg.early_abort_epoch
+                and stats["train_accuracy_mean"] < cfg.early_abort_train_acc
+            ):
+                msg = (
+                    f"EARLY ABORT: train_acc {stats['train_accuracy_mean']:.4f} < "
+                    f"{cfg.early_abort_train_acc} at epoch {epoch} "
+                    f"(>= early_abort_epoch {cfg.early_abort_epoch}) — diverged"
+                )
+                print(msg, flush=True)
+                storage.append_jsonl(
+                    self.logs_dir, {"ts": time.time(), "event": "early_abort", **stats}
+                )
+                storage.change_json_log_experiment_status(
+                    self.logs_dir, self.experiment_name, msg
+                )
+                raise SystemExit(3)
         self.load_best()
         test_stats = self.evaluate_test()
         return {

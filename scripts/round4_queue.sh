@@ -73,8 +73,12 @@ W5S1="num_classes_per_set=5 num_samples_per_class=1"
 W5S5="num_classes_per_set=5 num_samples_per_class=5"
 W20S1="num_classes_per_set=20 num_samples_per_class=1"
 W20S5="num_classes_per_set=20 num_samples_per_class=5"
-NODONATE5="omniglot.20.5.vgg.gd.nodonate.0 $W20S5 donate_train_state=false"
-NODONATE1="omniglot.20.1.vgg.gd.nodonate.0 $W20S1 donate_train_state=false"
+# early-abort: if a nodonate row is still <15% train acc after 3 epochs the
+# donation fix didn't take — release the chip (rc=3, permanent) instead of
+# burning its 150-epoch budget
+EABORT="early_abort_train_acc=0.15 early_abort_epoch=3"
+NODONATE5="omniglot.20.5.vgg.gd.nodonate.0 $W20S5 donate_train_state=false $EABORT"
+NODONATE1="omniglot.20.1.vgg.gd.nodonate.0 $W20S1 donate_train_state=false $EABORT"
 # If the chain's X8 arm (3-epoch 20w5s donation-off) already ran and STILL
 # collapsed (epoch-2 train acc <= 0.25), donation isn't the fix — demote the
 # full-budget nodonate rows behind the guaranteed-value 5-way rows. The

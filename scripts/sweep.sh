@@ -51,6 +51,12 @@ run () {
     wait $pid; rc=$?
     echo "=== $(date -u +%H:%M:%S) $name attempt=$attempt rc=$rc" >> exps/sweep_r3.log
     [ $rc -eq 0 ] && return 0
+    if [ $rc -eq 3 ]; then
+      # runner's early-divergence abort: permanent, not a transient failure —
+      # retrying resumes the same collapsing trajectory
+      echo "=== $(date -u +%H:%M:%S) $name EARLY-ABORTED (diverged), not retrying" >> exps/sweep_r3.log
+      return 1
+    fi
     sleep 10   # let the tunnel lease clear before reconnecting
   done
   echo "=== $(date -u +%H:%M:%S) $name FAILED after $MAX_RESTARTS restarts" >> exps/sweep_r3.log

@@ -148,3 +148,32 @@ def test_eval_stats_are_per_episode(toy_dataset, tmp_path):
     std = float(test_row["test_accuracy_std"])
     ci = float(test_row["test_accuracy_ci95"])
     assert abs(ci - 1.96 * std / np.sqrt(n_eval)) < 1e-9
+
+
+def test_early_abort_on_divergence(toy_dataset, tmp_path):
+    """early_abort_train_acc: a run still below the threshold after the
+    grace window exits with the distinct code 3 (sweep.sh treats it as
+    permanent), logs the event, and leaves its checkpoints behind."""
+    cfg = runner_config(
+        toy_dataset, tmp_path, experiment_name="toy_abort",
+        total_epochs=5, early_abort_train_acc=1.1, early_abort_epoch=1,
+    )
+    runner = ExperimentRunner(cfg, system=small_system(cfg))
+    with pytest.raises(SystemExit) as exc:
+        runner.run_experiment()
+    assert exc.value.code == 3
+    logs = os.path.join(runner.run_dir, "logs")
+    rows = load_statistics(logs)
+    assert len(rows) == 2  # epochs 0 and 1 ran; abort fired at epoch 1
+    import json
+    with open(os.path.join(logs, "events.jsonl")) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    assert any(e.get("event") == "early_abort" for e in events)
+    assert os.path.exists(
+        os.path.join(runner.run_dir, "saved_models", "train_model_latest")
+    )
+    # disabled by default: the same toy run with the knob off completes
+    cfg2 = runner_config(toy_dataset, tmp_path, experiment_name="toy_noabort",
+                         total_epochs=1)
+    assert cfg2.early_abort_train_acc == 0.0
+    ExperimentRunner(cfg2, system=small_system(cfg2)).run_experiment()
