@@ -419,13 +419,15 @@ class ExperimentRunner:
             # full-budget run. Checkpoints up to this epoch remain on disk.
             if (
                 cfg.early_abort_train_acc > 0.0
-                and epoch >= cfg.early_abort_epoch
+                # epoch is 0-based: after completing epoch index N-1,
+                # exactly N epochs have run — the documented grace window
+                and epoch + 1 >= cfg.early_abort_epoch
                 and stats["train_accuracy_mean"] < cfg.early_abort_train_acc
             ):
                 msg = (
                     f"EARLY ABORT: train_acc {stats['train_accuracy_mean']:.4f} < "
-                    f"{cfg.early_abort_train_acc} at epoch {epoch} "
-                    f"(>= early_abort_epoch {cfg.early_abort_epoch}) — diverged"
+                    f"{cfg.early_abort_train_acc} after {epoch + 1} epochs "
+                    f"(early_abort_epoch {cfg.early_abort_epoch}) — diverged"
                 )
                 print(msg, flush=True)
                 storage.append_jsonl(

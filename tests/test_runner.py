@@ -156,7 +156,7 @@ def test_early_abort_on_divergence(toy_dataset, tmp_path):
     permanent), logs the event, and leaves its checkpoints behind."""
     cfg = runner_config(
         toy_dataset, tmp_path, experiment_name="toy_abort",
-        total_epochs=5, early_abort_train_acc=1.1, early_abort_epoch=1,
+        total_epochs=5, early_abort_train_acc=1.1, early_abort_epoch=2,
     )
     runner = ExperimentRunner(cfg, system=small_system(cfg))
     with pytest.raises(SystemExit) as exc:
@@ -164,7 +164,8 @@ def test_early_abort_on_divergence(toy_dataset, tmp_path):
     assert exc.value.code == 3
     logs = os.path.join(runner.run_dir, "logs")
     rows = load_statistics(logs)
-    assert len(rows) == 2  # epochs 0 and 1 ran; abort fired at epoch 1
+    # grace window is exactly early_abort_epoch epochs: indices 0 and 1 ran
+    assert len(rows) == 2
     import json
     with open(os.path.join(logs, "events.jsonl")) as f:
         events = [json.loads(line) for line in f if line.strip()]
@@ -172,8 +173,6 @@ def test_early_abort_on_divergence(toy_dataset, tmp_path):
     assert os.path.exists(
         os.path.join(runner.run_dir, "saved_models", "train_model_latest")
     )
-    # disabled by default: the same toy run with the knob off completes
-    cfg2 = runner_config(toy_dataset, tmp_path, experiment_name="toy_noabort",
-                         total_epochs=1)
-    assert cfg2.early_abort_train_acc == 0.0
-    ExperimentRunner(cfg2, system=small_system(cfg2)).run_experiment()
+    # disabled by default (the default-config end-to-end test above already
+    # proves a default run completes)
+    assert Config(dataset=DatasetConfig(name="omniglot_toy", path=toy_dataset)).early_abort_train_acc == 0.0
