@@ -35,6 +35,14 @@ if ! grep -q "diag chain done" exps/diag/chain.log 2>/dev/null; then
   bash scripts/diag_chain.sh
 fi
 cp -f exps/diag/chain.log results/r4/diag_chain.log 2>/dev/null
+# collect the X-arm run artifacts (logs/CSVs, not checkpoints) durably
+for d in exps/diag/*/; do
+  [ -d "$d/logs" ] || continue
+  n=$(basename "$d")
+  mkdir -p "results/r4/diag/$n"
+  cp -f "$d"/config.yaml "$d"/lrs.csv "results/r4/diag/$n/" 2>/dev/null
+  cp -rf "$d"/logs "results/r4/diag/$n/" 2>/dev/null
+done
 echo "=== $(date -u +%H:%M:%S) diag chain done; running bench" >> "$LOG"
 
 BENCH_STARTUP_DEADLINE_S=7200 timeout --kill-after=30 9000 \
