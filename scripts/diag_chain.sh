@@ -27,7 +27,12 @@ gate () {
   }
 }
 
-gate "descent probe" 18000
+gate "donation probe" 18000
+echo "=== $(date -u +%H:%M:%S) [0/4] donation A/B probe, streamed inputs (top suspect; minutes)" >> "$LOG"
+timeout --kill-after=30 1200 python -u scripts/donation_probe.py 40 20 5 8 >> "$LOG" 2>&1
+echo "=== donation probe rc=$?" >> "$LOG"
+
+gate "descent probe" 3600
 echo "=== $(date -u +%H:%M:%S) [1/4] on-chip descent probe, UNROLLED (the production program family)" >> "$LOG"
 timeout --kill-after=30 900 python -u scripts/descent_probe.py 0 20 25 1 >> "$LOG" 2>&1
 echo "=== probe(unrolled) rc=$?" >> "$LOG"

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Minimal buffer-donation reproducer: same seed, same batch sequence, two
+arms — ``donate_train_state=true`` vs ``false`` — run stepwise with a FRESH
+``device_put`` of a different batch every step (mimicking the training
+loader's H2D churn, which the repeated-batch descent probe never exercises:
+a donated buffer freed mid-step and reused by an incoming transfer is
+exactly the aliasing bug class that only shows up with streaming inputs).
+
+Donation must be a pure memory optimization: both arms must produce the
+same per-step losses and final parameters up to float reordering. A
+divergence on the chip (CPU control is bit-identical because donation is
+ignored there) is the smoking gun for the 20-way collapse's top suspect
+(results/r4/DIAG_20way_r4.md).
+
+Argv: [n_steps=40] [n_way=20] [k_shot=5] [batch_size=8]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.config import Config
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+
+
+def run_arm(cfg: Config, n_steps: int, n_batches: int = 16):
+    system = MAMLSystem(cfg)
+    state = system.init_train_state()
+    losses = []
+    for i in range(n_steps):
+        # fresh host->device transfer every step, like the real loader —
+        # the donated previous state's buffers are free for reuse by these
+        # incoming copies, which is the aliasing window under test
+        host = synthetic_batch(
+            cfg.batch_size,
+            cfg.num_classes_per_set,
+            cfg.num_samples_per_class,
+            cfg.num_target_samples,
+            cfg.image_shape,
+            seed=i % n_batches,
+        )
+        batch = {k: jax.device_put(np.asarray(v)) for k, v in host.items()}
+        state, out = system.train_step(state, batch, epoch=0)
+        losses.append(float(out.loss))
+    return losses, jax.device_get(state.params)
+
+
+def main():
+    n_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 40
+    n_way = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    k_shot = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    batch_size = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+
+    base = Config(
+        num_classes_per_set=n_way,
+        num_samples_per_class=k_shot,
+        batch_size=batch_size,
+        unroll_inner_steps=True,  # the production program family
+        remat_inner_steps=False,
+    )
+    print(
+        f"donation probe: backend={jax.default_backend()} n_steps={n_steps} "
+        f"{n_way}w{k_shot}s b{batch_size}",
+        flush=True,
+    )
+    loss_d, params_d = run_arm(dataclasses.replace(base, donate_train_state=True), n_steps)
+    loss_n, params_n = run_arm(dataclasses.replace(base, donate_train_state=False), n_steps)
+
+    max_loss_dev = max(abs(a - b) for a, b in zip(loss_d, loss_n))
+    first_dev = next(
+        (i for i, (a, b) in enumerate(zip(loss_d, loss_n)) if abs(a - b) > 1e-5), None
+    )
+    print(f"per-step loss: max |donate - nodonate| = {max_loss_dev:.3e} "
+          f"(first step deviating >1e-5: {first_dev})", flush=True)
+
+    worst_rel = 0.0
+    for (path_d, leaf_d), (_, leaf_n) in zip(
+        jax.tree_util.tree_flatten_with_path(params_d)[0],
+        jax.tree_util.tree_flatten_with_path(params_n)[0],
+    ):
+        a, b = np.asarray(leaf_d, np.float64), np.asarray(leaf_n, np.float64)
+        denom = np.linalg.norm(b) or 1.0
+        rel = np.linalg.norm(a - b) / denom
+        worst_rel = max(worst_rel, rel)
+        if rel > 1e-4:
+            print(f"  DIVERGED {jax.tree_util.keystr(path_d)}: rel |Δ| = {rel:.3e}", flush=True)
+    print(f"final params: worst relative divergence = {worst_rel:.3e}", flush=True)
+    # float-reorder noise between two identical-math programs is ~1e-6 rel;
+    # donation corruption is orders of magnitude beyond it
+    verdict = "DONATION-CORRUPTION" if (worst_rel > 1e-3 or max_loss_dev > 1e-2) else "clean"
+    print(f"verdict: {verdict}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
