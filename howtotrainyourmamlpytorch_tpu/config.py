@@ -133,6 +133,11 @@ class Config:
                 f"matmul_precision must be 'default', 'high' or 'highest', "
                 f"got {self.matmul_precision!r}"
             )
+        if self.train_steps_per_dispatch < 1:
+            raise ValueError(
+                f"train_steps_per_dispatch must be >= 1, "
+                f"got {self.train_steps_per_dispatch}"
+            )
 
     # --- episode shape (reference config.yaml:22-26) ---
     num_classes_per_set: int = 20
@@ -223,13 +228,26 @@ class Config:
     # emulation (~6 passes). Applied process-wide by the entry point /
     # MAMLSystem via jax.config jax_default_matmul_precision.
     matmul_precision: str = "default"  # default | high | highest
+    # Outer steps fused into one device dispatch (lax.scan over a stacked
+    # [K]-batch chunk, core/maml.py::train_step_multi). Identical math to
+    # K single dispatches; amortizes per-call host/RPC overhead — material
+    # when the chip sits behind a network tunnel. 1 = one dispatch per step.
+    # total_iter_per_epoch need not divide evenly: the remainder runs
+    # through the single-step path.
+    train_steps_per_dispatch: int = 1
     # Donate the TrainState buffers to the compiled train step (halves HBM
-    # for the state and lets XLA update in place). Off = keep inputs alive —
-    # a diagnostic/workaround switch for PJRT plugins whose input/output
-    # aliasing is suspect (donation is ignored on CPU, so a donation bug is
-    # exactly the kind of failure that reproduces on a device but not in
-    # CPU tests).
-    donate_train_state: bool = True
+    # for the state and lets XLA update in place). Donation must be a pure
+    # memory optimization, but on the attached TPU's PJRT plugin it is NOT:
+    # the round-4 A/B probe (scripts/donation_probe.py, 40 streamed steps,
+    # 20w5s b8) measured per-step losses diverging from the no-donate arm at
+    # step 0 and final params off by up to 32% rel
+    # (results/r4/diag_chain.log, verdict DONATION-CORRUPTION) — the
+    # corruption signature behind the 20-way on-chip training collapse
+    # (results/r4/DIAG_20way_r4.md). Donation is ignored on CPU, which is
+    # why every CPU probe was healthy. Default OFF: these models' train
+    # state is ~.5 MB, so donation buys nothing here; turn on only on a
+    # platform whose aliasing you have verified with the probe.
+    donate_train_state: bool = False
     # Force the lax.reduce_window max-pool path (select_and_scatter backward
     # == torch's first-argmax tie subgradient) instead of the faster
     # reshape+max path (even-split tie subgradient). The conventions differ

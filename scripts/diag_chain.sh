@@ -3,17 +3,23 @@
 # Gates on the tunnel before EVERY step (it wedges for hours; a single
 # up-front gate would let later steps burn their whole timeout against a
 # dead backend), then runs, logging into exps/diag/:
+#  0. donation A/B probe with streamed H2D inputs — the top suspect.
+#     ROUND-4 RESULT: verdict DONATION-CORRUPTION, 32% rel param divergence
+#     after 40 steps (results/r4/diag_chain.log) -> donate_train_state now
+#     defaults to false (config.py).
 #  1. descent probe on the chip — can it descend on one fixed 20-way batch
 #     that CPU descends on under worse precision?
-#  2. 3-epoch 20w5s stream run with donate_train_state=false — input/output
-#     aliasing suspect: donation is ignored on CPU, so a plugin aliasing bug
-#     reproduces on-device only, and corrupted state accumulating across
-#     steps matches the observed "epoch 0 learns, then collapse".
+#  2. 3-epoch 20w5s stream run with donate_train_state=false — fix
+#     verification for the donation finding.
 #  3. 3-epoch 20w5s stream run with matmul_precision=high — isolates the
-#     MXU bf16 default pass.
+#     MXU bf16 default pass (now also donation-off via the flipped default).
 #  4. 3-epoch 20w5s stream run with rolled scan + remat — a different XLA
 #     program family; dodges a possible miscompile of the big unrolled
 #     second-order graph.
+#
+# RESUMABLE: each arm writes an "rc=0" marker to the log on success; a
+# re-run (the queue restarts the chain after a gate-deadline abort) skips
+# arms already marked done instead of burning chip minutes repeating them.
 set -u
 cd /root/repo
 mkdir -p exps/diag
@@ -27,19 +33,27 @@ gate () {
   }
 }
 
-gate "donation probe" 18000
-echo "=== $(date -u +%H:%M:%S) [0/4] donation A/B probe, streamed inputs (top suspect; minutes)" >> "$LOG"
-timeout --kill-after=30 1200 python -u scripts/donation_probe.py 40 20 5 8 >> "$LOG" 2>&1
-echo "=== donation probe rc=$?" >> "$LOG"
+arm_done () { grep -q "=== $1 rc=0" "$LOG" 2>/dev/null; }
 
-gate "descent probe" 3600
-echo "=== $(date -u +%H:%M:%S) [1/4] on-chip descent probe, UNROLLED (the production program family)" >> "$LOG"
-timeout --kill-after=30 900 python -u scripts/descent_probe.py 0 20 25 1 >> "$LOG" 2>&1
-echo "=== probe(unrolled) rc=$?" >> "$LOG"
-gate "descent probe rolled" 3600
-echo "=== $(date -u +%H:%M:%S) [1b/4] on-chip descent probe, rolled variant" >> "$LOG"
-timeout --kill-after=30 900 python -u scripts/descent_probe.py 0 20 25 0 >> "$LOG" 2>&1
-echo "=== probe(rolled) rc=$?" >> "$LOG"
+if ! arm_done "donation probe"; then
+  gate "donation probe" 18000
+  echo "=== $(date -u +%H:%M:%S) [0/4] donation A/B probe, streamed inputs (top suspect; minutes)" >> "$LOG"
+  timeout --kill-after=30 1200 python -u scripts/donation_probe.py 40 20 5 8 >> "$LOG" 2>&1
+  echo "=== donation probe rc=$?" >> "$LOG"
+fi
+
+if ! arm_done "probe(unrolled)"; then
+  gate "descent probe" 3600
+  echo "=== $(date -u +%H:%M:%S) [1/4] on-chip descent probe, UNROLLED (the production program family)" >> "$LOG"
+  timeout --kill-after=30 900 python -u scripts/descent_probe.py 0 20 25 1 >> "$LOG" 2>&1
+  echo "=== probe(unrolled) rc=$?" >> "$LOG"
+fi
+if ! arm_done "probe(rolled)"; then
+  gate "descent probe rolled" 3600
+  echo "=== $(date -u +%H:%M:%S) [1b/4] on-chip descent probe, rolled variant" >> "$LOG"
+  timeout --kill-after=30 900 python -u scripts/descent_probe.py 0 20 25 0 >> "$LOG" 2>&1
+  echo "=== probe(rolled) rc=$?" >> "$LOG"
+fi
 
 COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
  dataset.path=/root/reference/datasets/omniglot_dataset \
@@ -47,21 +61,27 @@ COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
  num_classes_per_set=20 num_samples_per_class=5 net=vgg total_epochs=3 \
  experiment_root=exps/diag"
 
-gate "X8 donation-off" 3600
-echo "=== $(date -u +%H:%M:%S) [2/4] stream 3ep donation OFF (aliasing suspect)" >> "$LOG"
-timeout --kill-after=30 2400 python -u train_maml_system.py $COMMON remat_inner_steps=false \
-  donate_train_state=false experiment_name=X8.nodonate >> "$LOG" 2>&1
-echo "=== X8 rc=$?" >> "$LOG"
+if ! arm_done "X8"; then
+  gate "X8 donation-off" 3600
+  echo "=== $(date -u +%H:%M:%S) [2/4] stream 3ep donation OFF (aliasing suspect)" >> "$LOG"
+  timeout --kill-after=30 2400 python -u train_maml_system.py $COMMON remat_inner_steps=false \
+    donate_train_state=false experiment_name=X8.nodonate >> "$LOG" 2>&1
+  echo "=== X8 rc=$?" >> "$LOG"
+fi
 
-gate "X3 precision-high" 3600
-echo "=== $(date -u +%H:%M:%S) [3/4] stream 3ep matmul_precision=high" >> "$LOG"
-timeout --kill-after=30 2400 python -u train_maml_system.py $COMMON remat_inner_steps=false \
-  matmul_precision=high experiment_name=X3.high >> "$LOG" 2>&1
-echo "=== X3 rc=$?" >> "$LOG"
+if ! arm_done "X3"; then
+  gate "X3 precision-high" 3600
+  echo "=== $(date -u +%H:%M:%S) [3/4] stream 3ep matmul_precision=high" >> "$LOG"
+  timeout --kill-after=30 2400 python -u train_maml_system.py $COMMON remat_inner_steps=false \
+    matmul_precision=high experiment_name=X3.high >> "$LOG" 2>&1
+  echo "=== X3 rc=$?" >> "$LOG"
+fi
 
-gate "X7 rolled+remat" 3600
-echo "=== $(date -u +%H:%M:%S) [4/4] stream 3ep rolled scan + remat" >> "$LOG"
-timeout --kill-after=30 2400 python -u train_maml_system.py $COMMON remat_inner_steps=true \
-  unroll_inner_steps=false experiment_name=X7.rolled >> "$LOG" 2>&1
-echo "=== X7 rc=$?" >> "$LOG"
+if ! arm_done "X7"; then
+  gate "X7 rolled+remat" 3600
+  echo "=== $(date -u +%H:%M:%S) [4/4] stream 3ep rolled scan + remat" >> "$LOG"
+  timeout --kill-after=30 2400 python -u train_maml_system.py $COMMON remat_inner_steps=true \
+    unroll_inner_steps=false experiment_name=X7.rolled >> "$LOG" 2>&1
+  echo "=== X7 rc=$?" >> "$LOG"
+fi
 echo "=== $(date -u +%H:%M:%S) diag chain done" >> "$LOG"

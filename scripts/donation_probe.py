@@ -13,6 +13,15 @@ ignored there) is the smoking gun for the 20-way collapse's top suspect
 (results/r4/DIAG_20way_r4.md).
 
 Argv: [n_steps=40] [n_way=20] [k_shot=5] [batch_size=8]
+
+``selfcheck`` as argv[1] runs the determinism control instead: each arm
+twice on the identical stream, compared to ITSELF. Same-program re-runs
+diverging = the chip is nondeterministic in general; self-reproducible arms
+that differ from each other = donation (the only program difference) is the
+corruption. This closes the one confound in the A/B verdict — donate and
+no-donate compile different programs, so in principle float reordering
+could differ between them (though reorder noise is ~1e-6 rel, far below
+the measured 3.2e-1).
 """
 import os
 import sys
@@ -34,8 +43,10 @@ from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
 from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
 
 
-def run_arm(cfg: Config, n_steps: int, n_batches: int = 16):
-    system = MAMLSystem(cfg)
+def run_arm(cfg: Config, n_steps: int, n_batches: int = 16, system: MAMLSystem = None):
+    # selfcheck passes the arm's system in so the re-run reuses its compiled
+    # program instead of burning a second multi-minute on-chip compile
+    system = system or MAMLSystem(cfg)
     state = system.init_train_state()
     losses = []
     for i in range(n_steps):
@@ -56,7 +67,65 @@ def run_arm(cfg: Config, n_steps: int, n_batches: int = 16):
     return losses, jax.device_get(state.params)
 
 
+def _rel_divs(params_a, params_b):
+    """[(path_str, rel ||a-b||/||b||)] per leaf, two same-structure trees."""
+    out = []
+    for (path_a, leaf_a), (_, leaf_b) in zip(
+        jax.tree_util.tree_flatten_with_path(params_a)[0],
+        jax.tree_util.tree_flatten_with_path(params_b)[0],
+    ):
+        a, b = np.asarray(leaf_a, np.float64), np.asarray(leaf_b, np.float64)
+        rel = np.linalg.norm(a - b) / (np.linalg.norm(b) or 1.0)
+        out.append((jax.tree_util.keystr(path_a), rel))
+    return out
+
+
+def _worst_rel(params_a, params_b):
+    return max(rel for _, rel in _rel_divs(params_a, params_b))
+
+
+def selfcheck(argv):
+    n_steps = int(argv[0]) if len(argv) > 0 else 40
+    n_way = int(argv[1]) if len(argv) > 1 else 20
+    k_shot = int(argv[2]) if len(argv) > 2 else 5
+    batch_size = int(argv[3]) if len(argv) > 3 else 8
+    base = Config(
+        num_classes_per_set=n_way,
+        num_samples_per_class=k_shot,
+        batch_size=batch_size,
+        unroll_inner_steps=True,
+        remat_inner_steps=False,
+    )
+    print(
+        f"donation selfcheck: backend={jax.default_backend()} n_steps={n_steps} "
+        f"{n_way}w{k_shot}s b{batch_size}",
+        flush=True,
+    )
+    runs = {}
+    for donate in (True, False):
+        cfg = dataclasses.replace(base, donate_train_state=donate)
+        system = MAMLSystem(cfg)
+        runs[donate] = [run_arm(cfg, n_steps, system=system) for _ in range(2)]
+        (loss_a, p_a), (loss_b, p_b) = runs[donate]
+        max_loss = max(abs(x - y) for x, y in zip(loss_a, loss_b))
+        rel = _worst_rel(p_a, p_b)
+        # two-signal label like main()'s verdict: a loss-trace deviation is
+        # nondeterminism even if the params happen to land back together
+        nondet = rel > 1e-4 or max_loss > 1e-4
+        print(
+            f"  donate={donate} run-vs-rerun: max |loss dev| = {max_loss:.3e}, "
+            f"worst param rel |d| = {rel:.3e} "
+            f"({'NONDETERMINISTIC' if nondet else 'self-reproducible'})",
+            flush=True,
+        )
+    cross = _worst_rel(runs[True][0][1], runs[False][0][1])
+    print(f"  donate-vs-nodonate (run 0): worst param rel |d| = {cross:.3e}", flush=True)
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "selfcheck":
+        selfcheck(sys.argv[2:])
+        return
     n_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 40
     n_way = int(sys.argv[2]) if len(sys.argv) > 2 else 20
     k_shot = int(sys.argv[3]) if len(sys.argv) > 3 else 5
@@ -84,17 +153,11 @@ def main():
     print(f"per-step loss: max |donate - nodonate| = {max_loss_dev:.3e} "
           f"(first step deviating >1e-5: {first_dev})", flush=True)
 
-    worst_rel = 0.0
-    for (path_d, leaf_d), (_, leaf_n) in zip(
-        jax.tree_util.tree_flatten_with_path(params_d)[0],
-        jax.tree_util.tree_flatten_with_path(params_n)[0],
-    ):
-        a, b = np.asarray(leaf_d, np.float64), np.asarray(leaf_n, np.float64)
-        denom = np.linalg.norm(b) or 1.0
-        rel = np.linalg.norm(a - b) / denom
-        worst_rel = max(worst_rel, rel)
+    divs = _rel_divs(params_d, params_n)
+    worst_rel = max(rel for _, rel in divs)
+    for path, rel in divs:
         if rel > 1e-4:
-            print(f"  DIVERGED {jax.tree_util.keystr(path_d)}: rel |Δ| = {rel:.3e}", flush=True)
+            print(f"  DIVERGED {path}: rel |Δ| = {rel:.3e}", flush=True)
     print(f"final params: worst relative divergence = {worst_rel:.3e}", flush=True)
     # float-reorder noise between two identical-math programs is ~1e-6 rel;
     # donation corruption is orders of magnitude beyond it

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Runs the donation-probe determinism control (donation_probe.py selfcheck)
+# as soon as the round-4 chip queue releases the chip — the control needs
+# the real device, and the tunnel is single-client, so it must not contend
+# with the diag chain / bench / sweep (results/r4/DIAG_20way_r4.md).
+#
+# Usage: scripts/selfcheck_watch.sh <queue_pid>
+set -u
+cd /root/repo
+QPID=${1:-}
+LOG=results/r4/donation_selfcheck.log
+mkdir -p results/r4
+if [ -n "$QPID" ]; then
+  # same PID-recycling guard as round4_queue.sh
+  while kill -0 "$QPID" 2>/dev/null \
+      && grep -aq round4_queue "/proc/$QPID/cmdline" 2>/dev/null; do
+    sleep 120
+  done
+fi
+echo "=== $(date -u +%H:%M:%S) queue gone, gating on tunnel for selfcheck" >> "$LOG"
+python -u scripts/wait_for_tpu.py 7200 60 >> "$LOG" 2>&1 || {
+  echo "=== $(date -u +%H:%M:%S) tunnel gate deadline, selfcheck not run" >> "$LOG"
+  exit 1
+}
+timeout --kill-after=30 1800 python -u scripts/donation_probe.py selfcheck 40 20 5 8 >> "$LOG" 2>&1
+echo "=== $(date -u +%H:%M:%S) selfcheck rc=$?" >> "$LOG"
