@@ -153,6 +153,7 @@ class MAMLSystem:
         # two code paths (few_shot_learning_system.py:239-251) without paying
         # num_steps target forwards when only the last one counts.
         self._train_step_cache = {}
+        self._train_multi_cache = {}
         self._eval_step = jax.jit(self._eval_step_impl)
 
     # ------------------------------------------------------------------
@@ -498,3 +499,53 @@ class MAMLSystem:
 
     def eval_step(self, state: TrainState, batch) -> StepOutput:
         return self._eval_step(state, batch)
+
+    # ------------------------------------------------------------------
+    # multi-step dispatch
+    # ------------------------------------------------------------------
+
+    def _train_multi_impl(self, state: TrainState, batches, *, second_order: bool, msl_active: bool):
+        def body(carry, batch):
+            new_state, out = self._train_step_impl(
+                carry, batch, second_order=second_order, msl_active=msl_active
+            )
+            # light per-step outputs only — the training loop consumes just
+            # these three; hauling K x [B, ...] per-task logits through the
+            # scan carry would cost HBM and D2H for nothing
+            return new_state, (out.loss, out.accuracy, out.learning_rate)
+        return jax.lax.scan(body, state, batches)
+
+    def _compiled_train_multi(self, second_order: bool, msl_active: bool):
+        key = (second_order, msl_active)
+        if key not in self._train_multi_cache:
+            donate = (0,) if self.cfg.donate_train_state else ()
+            self._train_multi_cache[key] = jax.jit(
+                functools.partial(
+                    self._train_multi_impl, second_order=second_order, msl_active=msl_active
+                ),
+                donate_argnums=donate,
+            )
+        return self._train_multi_cache[key]
+
+    def train_step_multi(
+        self, state: TrainState, batches, epoch: int
+    ) -> Tuple[TrainState, Tuple]:
+        """K outer updates in ONE dispatch: ``lax.scan`` of the train step
+        over ``batches`` whose leaves carry a leading ``[K]`` axis (from
+        ``MetaLearningDataLoader.train_batch_chunks``). Identical math to K
+        ``train_step`` calls — the scan body IS ``_train_step_impl`` — but
+        one host->device dispatch and one transfer per K steps, which is
+        what matters when the chip sits behind a network tunnel whose
+        per-call RPC latency rivals the ~30 ms device step itself (the
+        measured 10-16 ms/step wall-vs-device gap, docs/DESIGN.md §6).
+
+        Returns ``(new_state, (losses[K], accuracies[K], learning_rates[K]))``.
+        The chunk must not span an epoch where the (second_order, msl_active)
+        program variant flips — the runner dispatches within one epoch, and
+        MSL's *within*-variant annealing stays exact because loss weights are
+        computed from the traced ``state.step`` each scan iteration.
+        """
+        step_fn = self._compiled_train_multi(
+            self.use_second_order(epoch), self.msl_active(epoch)
+        )
+        return step_fn(state, batches)

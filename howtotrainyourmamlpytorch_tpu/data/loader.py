@@ -85,6 +85,42 @@ class MetaLearningDataLoader:
 
     # ------------------------------------------------------------------
 
+    def _build_batch(self, split: str, base: int, augment: bool) -> Dict[str, np.ndarray]:
+        """Assemble the batch whose first global episode index is ``base``."""
+        ds = self.dataset
+        # this host's slice of the global batch (whole batch by default)
+        seeds = [
+            ds.episode_seed(split, base + j)
+            for j in range(self._local_lo, self._local_hi)
+        ]
+        # fast path: whole batch assembled by one native C++ call
+        # (gather+rot90+normalize+pack in native threads; ctypes releases
+        # the GIL, so prefetch still overlaps the device step)
+        batch = ds.sample_episode_batch(split, seeds, augment)
+        if batch is not None:
+            return batch
+        episodes = list(
+            self._episode_pool.map(
+                lambda s: ds.sample_episode(split, s, augment), seeds
+            )
+        )
+        return _stack(episodes)
+
+    def _prefetched(self, build, total: int, advance_per_yield: int) -> Iterator:
+        """Drive ``build(i)`` for i in [0, total) through the bounded
+        prefetch window, advancing the train cursor by ``advance_per_yield``
+        episodes as each item is handed to the consumer."""
+        window = self._PREFETCH_WINDOW
+        with concurrent.futures.ThreadPoolExecutor(max_workers=window) as ahead:
+            futures = {i: ahead.submit(build, i) for i in range(min(window, total))}
+            for i in range(total):
+                item = futures.pop(i).result()
+                nxt = i + window
+                if nxt < total:
+                    futures[nxt] = ahead.submit(build, nxt)
+                self.train_episodes_produced += advance_per_yield
+                yield item
+
     def _batches(
         self,
         split: str,
@@ -93,48 +129,38 @@ class MetaLearningDataLoader:
         augment: bool,
         advance_train_cursor: bool,
     ) -> Iterator[Dict[str, np.ndarray]]:
-        ds = self.dataset
         bs = self.batch_size
-
-        def build(batch_idx: int) -> Dict[str, np.ndarray]:
-            base = start_index + batch_idx * bs
-            # this host's slice of the global batch (whole batch by default)
-            seeds = [
-                ds.episode_seed(split, base + j)
-                for j in range(self._local_lo, self._local_hi)
-            ]
-            # fast path: whole batch assembled by one native C++ call
-            # (gather+rot90+normalize+pack in native threads; ctypes releases
-            # the GIL, so prefetch still overlaps the device step)
-            batch = ds.sample_episode_batch(split, seeds, augment)
-            if batch is not None:
-                return batch
-            episodes = list(
-                self._episode_pool.map(
-                    lambda s: ds.sample_episode(split, s, augment), seeds
-                )
-            )
-            return _stack(episodes)
-
-        window = self._PREFETCH_WINDOW
-        with concurrent.futures.ThreadPoolExecutor(max_workers=window) as ahead:
-            futures = {
-                i: ahead.submit(build, i) for i in range(min(window, total_batches))
-            }
-            for i in range(total_batches):
-                batch = futures.pop(i).result()
-                nxt = i + window
-                if nxt < total_batches:
-                    futures[nxt] = ahead.submit(build, nxt)
-                if advance_train_cursor:
-                    self.train_episodes_produced += bs
-                yield batch
+        build = lambda i: self._build_batch(split, start_index + i * bs, augment)
+        return self._prefetched(build, total_batches, bs if advance_train_cursor else 0)
 
     def train_batches(self, total_batches: int, augment_images: bool = True):
         """Deterministic resumable train stream (cursor advances per batch)."""
         return self._batches(
             "train", self.train_episodes_produced, total_batches, augment_images, True
         )
+
+    def train_batch_chunks(
+        self, total_chunks: int, chunk_size: int, augment_images: bool = True
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """The SAME deterministic train stream as ``train_batches``, grouped:
+        each yield stacks the next ``chunk_size`` batches under an extra
+        leading ``[chunk_size]`` axis for one multi-step device dispatch
+        (``MAMLSystem.train_step_multi``). Episode seeds, augmentation and
+        the resume cursor are batch-for-batch identical to the ungrouped
+        stream; stacking happens in the prefetch threads, off the dispatch
+        thread."""
+        bs = self.batch_size
+        start = self.train_episodes_produced
+
+        def build(chunk_idx: int) -> Dict[str, np.ndarray]:
+            return _stack([
+                self._build_batch(
+                    "train", start + (chunk_idx * chunk_size + k) * bs, augment_images
+                )
+                for k in range(chunk_size)
+            ])
+
+        return self._prefetched(build, total_chunks, bs * chunk_size)
 
     def val_batches(self, total_batches: int, augment_images: bool = False):
         return self._batches("val", 0, total_batches, augment_images, False)

@@ -26,6 +26,7 @@ from ..core import MAMLSystem, TrainState
 from ..data import FewShotDataset, MetaLearningDataLoader
 from ..parallel import (
     batch_sharding,
+    chunk_sharding,
     global_batch_from_local,
     make_mesh,
     shard_train_state,
@@ -140,6 +141,7 @@ class ExperimentRunner:
             # rationale in parallel/mesh.py::_param_spec)
             self.state = shard_train_state(self.state, self.mesh)
             self._batch_sharding = batch_sharding(self.mesh)
+            self._chunk_sharding = chunk_sharding(self.mesh)
 
         # multi-host SPMD: each host materializes only its slice of the global
         # meta-batch; _put stitches the global sharded arrays (SURVEY.md §5.8).
@@ -167,11 +169,12 @@ class ExperimentRunner:
 
     # ------------------------------------------------------------------
 
-    def _put(self, batch: Dict[str, np.ndarray]):
+    def _put(self, batch: Dict[str, np.ndarray], sharding=None):
         if self.mesh is not None:
+            sharding = sharding or self._batch_sharding
             if self._multihost:
-                return global_batch_from_local(batch, self.mesh, self._batch_sharding)
-            return jax.tree.map(lambda x: jax.device_put(x, self._batch_sharding), batch)
+                return global_batch_from_local(batch, self.mesh, sharding)
+            return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
         return jax.tree.map(jax.device_put, batch)
 
     def _train_epoch(self, epoch: int) -> Dict[str, Any]:
@@ -182,8 +185,29 @@ class ExperimentRunner:
         # trained epoch — past compile/warmup, short enough to inspect
         profile_this_epoch = bool(cfg.profile_dir) and not self._profiled
         prof_start, prof_stop = (10, 20) if cfg.total_iter_per_epoch >= 20 else (0, 1)
+        # multi-step dispatch (train_steps_per_dispatch=K): scan K outer
+        # steps per device call. The profiled epoch keeps K=1 so the trace
+        # window stays per-iter.
+        K = 1 if profile_this_epoch else max(1, cfg.train_steps_per_dispatch)
+        n_chunks, single_iters = divmod(cfg.total_iter_per_epoch, K)
+        if K > 1:
+            for chunk in self.loader.train_batch_chunks(
+                n_chunks, K, augment_images=True
+            ):
+                put = self._put(
+                    chunk,
+                    self._chunk_sharding if self.mesh is not None else None,
+                )
+                self.state, (chunk_losses, chunk_accs, chunk_lrs) = (
+                    self.system.train_step_multi(self.state, put, epoch)
+                )
+                losses.append(chunk_losses)
+                accs.append(chunk_accs)
+                lr = chunk_lrs[-1]
+        else:
+            single_iters = cfg.total_iter_per_epoch
         for it, batch in enumerate(
-            self.loader.train_batches(cfg.total_iter_per_epoch, augment_images=True)
+            self.loader.train_batches(single_iters, augment_images=True)
         ):
             if profile_this_epoch and it == prof_start:
                 jax.profiler.start_trace(cfg.profile_dir)
@@ -200,6 +224,8 @@ class ExperimentRunner:
         # one bulk fetch instead of 2*iters scalar device_gets (each a
         # round-trip when the chip sits behind a network tunnel)
         losses, accs = jax.device_get((losses, accs))
+        losses = np.concatenate([np.atleast_1d(x) for x in losses])
+        accs = np.concatenate([np.atleast_1d(x) for x in accs])
         loss_mean, loss_std = _mean_std(losses)
         acc_mean, acc_std = _mean_std(accs)
         return {

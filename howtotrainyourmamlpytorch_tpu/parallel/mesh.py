@@ -43,6 +43,12 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def chunk_sharding(mesh: Mesh) -> NamedSharding:
+    """Multi-step dispatch chunks ``[K, B, ...]`` (train_steps_per_dispatch):
+    scan axis replicated, meta-batch axis 1 sharded over dp."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -1,0 +1,165 @@
+"""Multi-step dispatch (train_steps_per_dispatch=K): K outer updates fused
+into one device call via lax.scan must be *identical math* to K single
+dispatches — same params, same per-step losses, same episode stream, same
+resume cursor. Amortizes per-dispatch host/RPC overhead (docs/DESIGN.md §6);
+no reference analogue (the torch loop dispatches per step by construction)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.config import Config
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data import MetaLearningDataLoader
+from howtotrainyourmamlpytorch_tpu.data.synthetic import learnable_synthetic_batch
+
+from .test_maml_core import TINY_SHAPE, _as_jnp, tiny_config, tiny_linear_model
+from .test_data import toy_config, toy_dataset  # noqa: F401  (fixture)
+
+
+def _batches(n, seed0=0):
+    return [
+        learnable_synthetic_batch(2, 3, 2, 2, TINY_SHAPE, seed=seed0 + i)
+        for i in range(n)
+    ]
+
+
+def _stacked(batches):
+    return {
+        k: jnp.stack([jnp.asarray(b[k]) for b in batches]) for k in batches[0]
+    }
+
+
+def test_train_step_multi_matches_sequential():
+    cfg = tiny_config()
+    K = 3
+    batches = _batches(K)
+
+    system_a = MAMLSystem(cfg, model=tiny_linear_model())
+    state_a = system_a.init_train_state()
+    seq_losses = []
+    for b in batches:
+        state_a, out = system_a.train_step(state_a, _as_jnp(b), epoch=0)
+        seq_losses.append(float(out.loss))
+
+    system_b = MAMLSystem(cfg, model=tiny_linear_model())
+    state_b = system_b.init_train_state()
+    state_b, (losses, accs, lrs) = system_b.train_step_multi(
+        state_b, _stacked(batches), epoch=0
+    )
+
+    np.testing.assert_allclose(np.asarray(losses), seq_losses, rtol=1e-5)
+    assert losses.shape == accs.shape == lrs.shape == (K,)
+    assert int(state_b.step) == K
+    for (path, leaf_a), (_, leaf_b) in zip(
+        sorted_leaves(state_a.params), sorted_leaves(state_b.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(leaf_a), np.asarray(leaf_b), rtol=1e-5, atol=1e-7,
+            err_msg=f"param {path} diverged between fused and sequential",
+        )
+    # the cosine schedule advanced identically
+    np.testing.assert_allclose(
+        float(lrs[-1]), float(system_a.schedule(K - 1)), rtol=1e-6
+    )
+
+
+def sorted_leaves(tree):
+    import jax
+
+    return sorted(
+        jax.tree_util.tree_flatten_with_path(tree)[0],
+        key=lambda kv: str(kv[0]),
+    )
+
+
+def test_chunked_stream_matches_ungrouped(toy_dataset):  # noqa: F811
+    """train_batch_chunks yields the same episodes as train_batches, stacked,
+    and advances the resume cursor identically."""
+    cfg = toy_config(toy_dataset)
+    plain = list(MetaLearningDataLoader(cfg).train_batches(4))
+
+    loader = MetaLearningDataLoader(cfg)
+    chunks = list(loader.train_batch_chunks(2, 2))
+    assert len(chunks) == 2
+    assert chunks[0]["x_support"].shape == (2,) + plain[0]["x_support"].shape
+    for c in range(2):
+        for k in range(2):
+            np.testing.assert_array_equal(
+                chunks[c]["x_support"][k], plain[2 * c + k]["x_support"]
+            )
+            np.testing.assert_array_equal(
+                chunks[c]["y_target"][k], plain[2 * c + k]["y_target"]
+            )
+    assert loader.train_episodes_produced == 4 * cfg.batch_size
+
+    # chunked consumption then resume: the next ungrouped batch continues
+    # the stream exactly where the chunks left off
+    nxt = next(iter(loader.train_batches(1)))
+    loader_ref = MetaLearningDataLoader(cfg, dataset=loader.dataset, current_iter=4)
+    np.testing.assert_array_equal(
+        nxt["x_support"], next(iter(loader_ref.train_batches(1)))["x_support"]
+    )
+
+
+def test_runner_epoch_with_multi_dispatch(toy_dataset, tmp_path):  # noqa: F811
+    """End-to-end epoch parity: same toy run with K=1 vs K=2 (+ remainder,
+    5 % 2 = 1 iter through the single-step path) produces identical epoch
+    statistics and final params."""
+    from howtotrainyourmamlpytorch_tpu.config import ParallelConfig
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
+    from howtotrainyourmamlpytorch_tpu.models import build_vgg
+
+    def run(k, name):
+        cfg = dataclasses.replace(
+            toy_config(toy_dataset),
+            total_epochs=1,
+            total_iter_per_epoch=5,
+            num_evaluation_tasks=2,
+            number_of_training_steps_per_iter=2,
+            number_of_evaluation_steps_per_iter=2,
+            train_steps_per_dispatch=k,
+            # dp mesh: the K=2 arm exercises chunk_sharding's [K, B] layout
+            parallel=ParallelConfig(dp=2),
+            experiment_root=str(tmp_path / name),
+        )
+        system = MAMLSystem(
+            cfg,
+            model=build_vgg(
+                (28, 28, 1), cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4
+            ),
+        )
+        runner = ExperimentRunner(cfg, system=system)
+        stats = runner._train_epoch(0)
+        return stats, runner.state
+
+    stats_1, state_1 = run(1, "k1")
+    stats_2, state_2 = run(2, "k2")
+    np.testing.assert_allclose(
+        stats_1["train_loss_mean"], stats_2["train_loss_mean"], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        stats_1["train_accuracy_mean"], stats_2["train_accuracy_mean"], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        stats_1["learning_rate"], stats_2["learning_rate"], rtol=1e-6
+    )
+    assert int(state_1.step) == int(state_2.step) == 5
+    # Scanned and per-step programs are different XLA programs. For this
+    # conv model on binary toy images the meta-objective is non-smooth
+    # (max-pool ties, LeakyReLU kinks): ~1e-7 reduction-reorder noise can
+    # flip a subgradient branch and the second-order inner loop amplifies
+    # it to ~5e-3 on params within 5 meta-steps (measured) — while the
+    # per-step loss stream above still agrees to 1e-5. Exact elementwise
+    # parity for the fused path is pinned where it is well-defined, on the
+    # smooth model in test_train_step_multi_matches_sequential; here we
+    # assert same-basin agreement, i.e. the chunked wiring fed the same
+    # stream through the same update rule.
+    for (path, a), (_, b) in zip(
+        sorted_leaves(state_1.params), sorted_leaves(state_2.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0.05, atol=0.02,
+            err_msg=f"param {path} diverged between K=1 and K=2 epochs",
+        )
