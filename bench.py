@@ -233,6 +233,7 @@ def main():
     else:
         steps_per_sec, steps_per_dispatch = single_steps_per_sec, 1
 
+
     # --- FLOPs per meta-step #1: XLA cost analysis of the exact compiled
     # program (may be unimplemented by the PJRT plugin -> None, never a crash).
     flops_hlo = None
@@ -284,6 +285,51 @@ def main():
     except Exception as e:
         print(f"bench: profile breakdown unavailable: {e}", file=sys.stderr)
 
+    # Batch-scaling arm (DESIGN.md §6 roofline: a bigger meta-batch raises
+    # the implicit-GEMM M rows; K/N MXU occupancy unchanged — does task
+    # throughput scale?). Diagnostic only; the flagship metric stays at the
+    # reference's B. Runs AFTER the trace so the profiled flagship step sees
+    # production HBM conditions, and frees its state before the report.
+    b16_steps_per_sec = None
+    b16_ratio = None
+    B16 = 2 * cfg.batch_size
+    if os.environ.get("BENCH_B16", "1") == "1":
+        try:
+            import dataclasses
+
+            cfg16 = dataclasses.replace(cfg, batch_size=B16)
+            system16 = MAMLSystem(cfg16)
+            state16 = system16.init_train_state()
+            batch16 = {
+                k: jnp.asarray(v)
+                for k, v in synthetic_batch(
+                    B16,
+                    cfg.num_classes_per_set,
+                    cfg.num_samples_per_class,
+                    cfg.num_target_samples,
+                    cfg.image_shape,
+                    seed=0,
+                ).items()
+            }
+            t0 = time.perf_counter()
+            state16, out16 = system16.train_step(state16, batch16, epoch=0)
+            out16.loss.block_until_ready()
+            print(
+                f"bench: B={B16} compile+warmup {time.perf_counter() - t0:.1f}s",
+                file=sys.stderr,
+            )
+            start = time.perf_counter()
+            for _ in range(15):
+                state16, out16 = system16.train_step(state16, batch16, epoch=0)
+            out16.loss.block_until_ready()
+            b16_steps_per_sec = 15 / (time.perf_counter() - start)
+            b16_ratio = (B16 * b16_steps_per_sec) / (
+                cfg.batch_size * single_steps_per_sec
+            )
+            del system16, state16, batch16, out16
+        except Exception as e:
+            print(f"bench: B={B16} arm unavailable: {e}", file=sys.stderr)
+
     # --- MFU = FLOPs/step x steps/s / chip peak. Measured per-op trace FLOPs
     # preferred (it is what actually executed); HLO cost analysis as backup;
     # chip peak from the trace's own plane stat, table as fallback. ---
@@ -305,6 +351,12 @@ def main():
                 "steps_per_sec_single_dispatch": round(single_steps_per_sec, 3),
                 "steps_per_sec_multi_dispatch": (
                     round(multi_steps_per_sec, 3) if multi_steps_per_sec else None
+                ),
+                "b16_steps_per_sec": (
+                    round(b16_steps_per_sec, 3) if b16_steps_per_sec else None
+                ),
+                "b16_tasks_per_sec_ratio": (
+                    round(b16_ratio, 3) if b16_ratio else None
                 ),
                 "flops_per_step": flops_per_step,
                 "flops_source": (

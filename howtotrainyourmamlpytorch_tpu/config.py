@@ -235,6 +235,12 @@ class Config:
     # total_iter_per_epoch need not divide evenly: the remainder runs
     # through the single-step path.
     train_steps_per_dispatch: int = 1
+    # Scan the whole fixed evaluation set inside one device call
+    # (core/maml.py::eval_step_multi) instead of one dispatch per eval
+    # batch. Same math; off by default so parity runs keep the
+    # on-chip-validated per-batch eval program (single-host only — the
+    # multi-host eval path gathers per batch).
+    eval_fused_dispatch: bool = False
     # Donate the TrainState buffers to the compiled train step (halves HBM
     # for the state and lets XLA update in place). Donation must be a pure
     # memory optimization, but on the attached TPU's PJRT plugin it is NOT:

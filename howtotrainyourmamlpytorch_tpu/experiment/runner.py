@@ -24,6 +24,7 @@ import numpy as np
 from ..config import Config, save_config
 from ..core import MAMLSystem, TrainState
 from ..data import FewShotDataset, MetaLearningDataLoader
+from ..data.loader import _stack
 from ..parallel import (
     batch_sharding,
     chunk_sharding,
@@ -245,6 +246,20 @@ class ExperimentRunner:
             if split == "val"
             else self.loader.test_batches(n_batches)
         )
+        if cfg.eval_fused_dispatch and not self._multihost:
+            # one scanned dispatch over the whole fixed eval set (the
+            # multi-host path stays per-batch: it gathers each [B_global]
+            # array across processes)
+            stacked = _stack(list(batches))  # [{k: [B,...]}] -> {k: [N,B,...]}
+            put = self._put(
+                stacked, self._chunk_sharding if self.mesh is not None else None
+            )
+            losses, accs = jax.device_get(
+                self.system.eval_step_multi(self.state, put)
+            )
+            return _episode_stats(
+                split, np.concatenate(losses), np.concatenate(accs)
+            )
         ep_losses, ep_accs = [], []
         for batch in batches:
             out = self.system.eval_step(self.state, self._put(batch))
