@@ -23,6 +23,7 @@ structured JSON error line and exits nonzero fast instead of hanging
 import json
 import os
 import sys
+import threading
 import time
 
 # must be set before any protobuf import (xplane parsing, utils/profiling.py)
@@ -123,6 +124,104 @@ def _peak_flops(device_kind: str):
     return None
 
 
+class _Watchdog:
+    """Guarantee the ONE JSON line reaches stdout even if the tunnel wedges
+    mid-run. A wedged device call never returns and is not interruptible from
+    Python (it hangs in C with the GIL released), so a timer thread watches a
+    per-stage deadline and, when it fires, emits whatever has been measured so
+    far via ``os._exit`` — which works from a secondary thread while the main
+    thread is hung. If the headline loops already completed, the partial
+    report (with ``wedged_at`` set) is a valid bench capture; before that, it
+    degrades to the structured-failure line. Round-4 motivation: a wedge
+    during the diagnostic trace arm trapped an already-measured headline in a
+    process that then had to be killed, reproducing round 3's null-bench
+    failure mode from a *live* chip."""
+
+    def __init__(self, report: dict, enabled: bool = True):
+        self.report = report
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._done = False
+        self._deadline = float("inf")
+        self._stage = "init"
+        if enabled:
+            t = threading.Thread(target=self._watch, daemon=True)
+            t.start()
+
+    def enter(self, stage: str, budget_s: float) -> None:
+        """Stage deadlines assume TPU-speed execution; when disabled (CPU —
+        there is no tunnel to wedge, and one core is legitimately 100x
+        slower) stages are tracked for reporting but never expire."""
+        self._stage = stage
+        if self.enabled:
+            self._deadline = time.monotonic() + budget_s
+            print(f"bench: stage {stage} (budget {budget_s:.0f}s)",
+                  file=sys.stderr, flush=True)
+
+    def update(self, **kw) -> None:
+        # all report mutations hold the lock so an emitting thread can never
+        # serialize a dict that is changing size under it
+        with self._lock:
+            self.report.update(kw)
+
+    def _emit_and_exit(self, stage_note: str) -> None:
+        """Single-shot partial emission from the watchdog or a signal
+        handler. Safe while the main thread is hung in a device call."""
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+            rc = 0 if self.report.get("value") is not None else 2
+            if rc == 0:
+                self.report["wedged_at"] = stage_note
+            else:
+                self.report["error"] = (
+                    f"run interrupted during stage {stage_note!r} before the "
+                    "headline measurement completed"
+                )
+            try:
+                payload = json.dumps(self.report)
+            except Exception as e:  # never die without the one JSON line
+                payload = json.dumps(
+                    {"metric": METRIC, "value": None,
+                     "unit": "meta-steps/sec/chip", "vs_baseline": None,
+                     "error": f"report serialization failed: {e!r}"}
+                )
+                rc = 2
+        print(payload, flush=True)
+        os._exit(rc)
+
+    def _watch(self) -> None:
+        while True:
+            time.sleep(10)
+            if time.monotonic() > self._deadline:
+                self._emit_and_exit(self._stage)
+
+    def on_sigterm(self, signum, frame) -> None:
+        # The queue's outer `timeout` SIGTERMs us; if the main thread is
+        # still alive this salvages whatever was measured (a hung main
+        # thread never runs this handler — the stage watchdog covers that).
+        # Signal handlers run ON the main thread, which may currently hold
+        # self._lock (inside update()/emit_final()) — taking it here would
+        # deadlock until the outer SIGKILL. Emit from a fresh thread
+        # instead: it blocks only until main releases the lock (main keeps
+        # running after the handler returns), then prints and exits.
+        threading.Thread(
+            target=self._emit_and_exit,
+            args=(f"{self._stage} (sigterm)",),
+            daemon=True,
+        ).start()
+
+    def emit_final(self) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+            self._deadline = float("inf")
+            payload = json.dumps(self.report)
+        print(payload, flush=True)
+
+
 def main():
     platform, device_kind, n_devices = _contact_device()
     print(
@@ -136,6 +235,32 @@ def main():
             "a single-core CPU number is not comparable to the per-chip "
             "baseline — set BENCH_ALLOW_CPU=1 to bench on CPU anyway"
         )
+
+    report = {
+        "metric": METRIC,
+        "value": None,
+        "unit": "meta-steps/sec/chip",
+        "vs_baseline": None,
+        "platform": f"{platform}:{device_kind}",
+    }
+    wd = _Watchdog(report, enabled=platform != "cpu")
+    import signal
+
+    signal.signal(signal.SIGTERM, wd.on_sigterm)
+
+    def _excepthook(tp, val, tb):
+        # a tunnel that *raises* (XlaRuntimeError etc.) instead of wedging
+        # must still produce the one JSON line — with the headline if it was
+        # already measured, as a structured failure otherwise
+        import traceback
+
+        traceback.print_exception(tp, val, tb)
+        sys.stderr.flush()
+        wd.update(stage_error=f"{tp.__name__}: {val}")
+        wd._emit_and_exit(f"{wd._stage} (exception)")
+
+    sys.excepthook = _excepthook
+    wd.enter("imports+build", 600)
 
     import jax
     import jax.numpy as jnp
@@ -190,11 +315,13 @@ def main():
     # warmup / compile. epoch is passed host-side (as the training loop does):
     # reading it from state.step would force a device sync per step and
     # serialize dispatch against execution.
+    wd.enter("compile+warmup", float(os.environ.get("BENCH_COMPILE_DEADLINE_S", 1200)))
     t0 = time.perf_counter()
     state, out = system.train_step(state, batch, epoch=0)
     out.loss.block_until_ready()
     print(f"bench: compile+warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
+    wd.enter("measure", 600)
     n_iters = 30
     start = time.perf_counter()
     for _ in range(n_iters):
@@ -202,6 +329,14 @@ def main():
     out.loss.block_until_ready()
     elapsed = time.perf_counter() - start
     single_steps_per_sec = n_iters / elapsed
+    wd.update(
+        value=round(single_steps_per_sec, 3),
+        vs_baseline=round(single_steps_per_sec / REFERENCE_STEPS_PER_SEC, 3),
+        steps_per_dispatch=1,
+        steps_per_sec_single_dispatch=round(single_steps_per_sec, 3),
+    )
+    print(f"bench: single-dispatch {single_steps_per_sec:.3f} steps/s",
+          file=sys.stderr, flush=True)
 
     # Multi-step dispatch (train_steps_per_dispatch=K in production): K outer
     # steps scanned inside ONE device call — amortizes the per-dispatch
@@ -212,6 +347,7 @@ def main():
     multi_steps_per_sec = None
     multi_dispatch_error = None
     if K > 1:
+        wd.enter("multi-dispatch", 900)
         try:
             stacked = {k: jnp.stack([v] * K) for k, v in batch.items()}
             t0 = time.perf_counter()
@@ -246,10 +382,21 @@ def main():
         steps_per_sec, steps_per_dispatch = multi_steps_per_sec, K
     else:
         steps_per_sec, steps_per_dispatch = single_steps_per_sec, 1
-
+    wd.update(
+        value=round(steps_per_sec, 3),
+        vs_baseline=round(steps_per_sec / REFERENCE_STEPS_PER_SEC, 3),
+        steps_per_dispatch=steps_per_dispatch,
+        steps_per_sec_multi_dispatch=(
+            round(multi_steps_per_sec, 3) if multi_steps_per_sec else None
+        ),
+        multi_dispatch_error=multi_dispatch_error,
+    )
+    print(f"bench: headline {steps_per_sec:.3f} steps/s "
+          f"(K={steps_per_dispatch})", file=sys.stderr, flush=True)
 
     # --- FLOPs per meta-step #1: XLA cost analysis of the exact compiled
     # program (may be unimplemented by the PJRT plugin -> None, never a crash).
+    wd.enter("cost-analysis", 600)
     flops_hlo = None
     try:
         # same program variant the timed loop selected for epoch=0
@@ -274,6 +421,7 @@ def main():
     breakdown = None
     flops_measured = None
     trace_peak = None
+    wd.enter("profile-trace", 600)
     try:
         from howtotrainyourmamlpytorch_tpu.utils.profiling import device_time_breakdown
 
@@ -308,6 +456,7 @@ def main():
     b16_ratio = None
     B16 = 2 * cfg.batch_size
     if os.environ.get("BENCH_B16", "1") == "1":
+        wd.enter("b16-arm", 1800)
         try:
             import dataclasses
 
@@ -353,37 +502,20 @@ def main():
     if flops_per_step and peak:
         mfu = round(flops_per_step * steps_per_sec / peak, 5)
 
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": round(steps_per_sec, 3),
-                "unit": "meta-steps/sec/chip",
-                "vs_baseline": round(steps_per_sec / REFERENCE_STEPS_PER_SEC, 3),
-                "platform": f"{platform}:{device_kind}",
-                "steps_per_dispatch": steps_per_dispatch,
-                "steps_per_sec_single_dispatch": round(single_steps_per_sec, 3),
-                "steps_per_sec_multi_dispatch": (
-                    round(multi_steps_per_sec, 3) if multi_steps_per_sec else None
-                ),
-                "multi_dispatch_error": multi_dispatch_error,
-                "b16_steps_per_sec": (
-                    round(b16_steps_per_sec, 3) if b16_steps_per_sec else None
-                ),
-                "b16_tasks_per_sec_ratio": (
-                    round(b16_ratio, 3) if b16_ratio else None
-                ),
-                "flops_per_step": flops_per_step,
-                "flops_source": (
-                    "trace" if flops_measured else ("hlo" if flops_hlo else None)
-                ),
-                "peak_flops_per_sec": peak,
-                "mfu": mfu,
-                "breakdown": breakdown,
-            }
+    wd.update(
+        b16_steps_per_sec=(
+            round(b16_steps_per_sec, 3) if b16_steps_per_sec else None
         ),
-        flush=True,
+        b16_tasks_per_sec_ratio=(round(b16_ratio, 3) if b16_ratio else None),
+        flops_per_step=flops_per_step,
+        flops_source=(
+            "trace" if flops_measured else ("hlo" if flops_hlo else None)
+        ),
+        peak_flops_per_sec=peak,
+        mfu=mfu,
+        breakdown=breakdown,
     )
+    wd.emit_final()
 
 
 if __name__ == "__main__":
