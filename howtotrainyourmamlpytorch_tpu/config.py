@@ -68,6 +68,13 @@ class ParallelConfig:
     pp: int = 1
     # shard tasks of one meta-batch across dp; meta-grads psum over the mesh.
     shard_meta_batch: bool = True
+    # Shard conv kernels output-channel-parallel over ``mp`` (in addition to
+    # the always-on column-parallel dense head). Requires the patches-GEMM
+    # conv implementation (Config.conv_via_patches, auto-enabled): GSPMD's
+    # convolution handler hard-crashes on this program family's sharded
+    # convs, a dot_general contraction partitions fine (models/layers.py
+    # CONV_VIA_PATCHES note, parallel/mesh.py::_param_spec).
+    tp_convs: bool = False
 
     def __post_init__(self):
         if self.pp != 1:
@@ -138,6 +145,11 @@ class Config:
                 f"train_steps_per_dispatch must be >= 1, "
                 f"got {self.train_steps_per_dispatch}"
             )
+        if getattr(self.parallel, "tp_convs", False) and not self.conv_via_patches:
+            # tp_convs is meaningless (and partitioner-fatal) on the native
+            # conv path; the patches-GEMM form is a strict requirement, so
+            # enable it rather than bounce the config back
+            self.conv_via_patches = True
 
     # --- episode shape (reference config.yaml:22-26) ---
     num_classes_per_set: int = 20
@@ -262,6 +274,11 @@ class Config:
     # pooling convention in/out during on-chip mixed-precision parity
     # debugging (see models/layers.py max_pool docstring, PARITY.md).
     max_pool_reduce_window: bool = False
+    # Express every conv as patch-extraction + dot_general (implicit GEMM
+    # made explicit; same math up to accumulation order). The enabler for
+    # parallel.tp_convs — see models/layers.py CONV_VIA_PATCHES — and
+    # auto-enabled by it; usable standalone for A/B perf or numerics probes.
+    conv_via_patches: bool = False
     # Early divergence abort (sweep-time guard; 0.0 disables): exit with
     # code 3 when train accuracy is still below this after
     # ``early_abort_epoch`` epochs — a collapsing run (the on-chip 20-way

@@ -145,6 +145,19 @@ class MAMLSystem:
                 stacklevel=2,
             )
         layers.FORCE_REDUCE_WINDOW_POOL = cfg.max_pool_reduce_window
+        # same pattern again: conv implementation selector (patches-GEMM vs
+        # native conv), the enabler for tensor-parallel conv kernels
+        # (parallel.tp_convs) — see models/layers.py CONV_VIA_PATCHES
+        prev_conv = layers.CONV_VIA_PATCHES
+        if prev_conv is not None and prev_conv != cfg.conv_via_patches:
+            warnings.warn(
+                f"MAMLSystem(conv_via_patches={cfg.conv_via_patches}) is "
+                f"flipping the process-wide conv implementation (was "
+                f"{prev_conv}); programs traced from now on (including by "
+                "OTHER live systems) use the new one",
+                stacklevel=2,
+            )
+        layers.CONV_VIA_PATCHES = cfg.conv_via_patches
 
         # Compiled program cache keyed by the static switches: (second_order,
         # msl_active). msl_active selects the rollout shape — per-step target

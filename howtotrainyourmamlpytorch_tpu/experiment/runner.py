@@ -138,9 +138,12 @@ class ExperimentRunner:
                 )
             self.mesh = mesh
             # dp: replicated train state; dp x mp: tensor-parallel shardings
-            # (dense-head kernel column-parallel over mp; convs replicated —
-            # rationale in parallel/mesh.py::_param_spec)
-            self.state = shard_train_state(self.state, self.mesh)
+            # (dense-head kernel column-parallel over mp; conv kernels too
+            # when parallel.tp_convs — rationale in
+            # parallel/mesh.py::_param_spec)
+            self.state = shard_train_state(
+                self.state, self.mesh, tp_convs=cfg.parallel.tp_convs
+            )
             self._batch_sharding = batch_sharding(self.mesh)
             self._chunk_sharding = chunk_sharding(self.mesh)
 

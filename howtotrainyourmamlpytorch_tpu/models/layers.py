@@ -91,8 +91,73 @@ def init_conv(key, kh, kw, cin, cout, bias=True, init="torch_default"):
     return params
 
 
+# Tensor-parallel enabler (Config.conv_via_patches, set by MAMLSystem like
+# FORCE_REDUCE_WINDOW_POOL above): route every conv through patch extraction
+# + dot_general instead of lax.conv_general_dilated. Trace-time static, same
+# flip-warning caveat. Why it exists: XLA's GSPMD partitioner hard-crashes in
+# convolution_handler.cc on this program family when conv operands carry
+# ``mp`` shardings (the vmap over per-task adapted kernels becomes a
+# batch-grouped convolution; see parallel/mesh.py::_param_spec). A dot_general
+# contraction has no such handler limits — GSPMD partitions it with the
+# standard matmul collectives — so expressing conv as patches x kernel-matrix
+# lets conv kernels shard over ``mp`` (output-channel / Megatron column style)
+# with activations gathered/partial-summed automatically. On TPU the MXU
+# executes convs as implicit GEMM anyway; this makes the GEMM explicit.
+CONV_VIA_PATCHES = None
+
+
+def extract_patches(x, kh, kw, stride=1, padding=0):
+    """im2col via pure slicing: NHWC -> [N, Ho, Wo, kh*kw, C].
+
+    No convolution primitive involved (a conv_general_dilated_patches-based
+    extraction would reintroduce the partitioner's convolution handler on
+    sharded inputs); slices and stacks keep the channel axis minor and
+    untouched, so a channel-sharded input stays sharded through extraction.
+    """
+    if not isinstance(padding, int):
+        # the native conv2d path also accepts explicit pair tuples; this
+        # path deliberately supports only the symmetric-int form the model
+        # zoo uses — fail loudly rather than mis-pad
+        raise TypeError(
+            f"patches conv supports symmetric int padding only, got {padding!r}"
+        )
+    if padding:
+        x = jnp.pad(x, ((0, 0), (padding, padding), (padding, padding), (0, 0)))
+    n, h, w, c = x.shape
+    ho = (h - kh) // stride + 1
+    wo = (w - kw) // stride + 1
+    cols = [
+        lax.slice(
+            x,
+            (0, i, j, 0),
+            (n, i + (ho - 1) * stride + 1, j + (wo - 1) * stride + 1, c),
+            (1, stride, stride, 1),
+        )
+        for i in range(kh)
+        for j in range(kw)
+    ]
+    return jnp.stack(cols, axis=3)
+
+
+def conv2d_patches(params, x, stride=1, padding=0):
+    """conv2d expressed as patches x reshaped kernel (implicit GEMM made
+    explicit). Same math as :func:`conv2d` up to f.p. accumulation order; the
+    contraction runs over (tap, cin) jointly so GSPMD can psum a
+    channel-sharded input against the matching kernel rows instead of
+    re-gathering (Megatron row-parallel pattern, automatic here)."""
+    w = params["w"]
+    kh, kw, cin, cout = w.shape
+    p = extract_patches(x, kh, kw, stride, padding)
+    out = jnp.einsum("nxykc,kcd->nxyd", p, w.reshape(kh * kw, cin, cout))
+    if "b" in params:
+        out = out + params["b"]
+    return out
+
+
 def conv2d(params, x, stride=1, padding=0):
     """3x3/1x1 conv, NHWC. ``padding`` is symmetric int (torch-style)."""
+    if CONV_VIA_PATCHES:
+        return conv2d_patches(params, x, stride, padding)
     pad = ((padding, padding), (padding, padding)) if isinstance(padding, int) else padding
     out = lax.conv_general_dilated(
         x,

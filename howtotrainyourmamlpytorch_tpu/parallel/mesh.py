@@ -63,32 +63,44 @@ def replicate(tree, mesh: Mesh):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
 
 
-def _param_spec(shape, mp: int) -> P:
+def _param_spec(shape, mp: int, tp_convs: bool = False) -> P:
     """Tensor-parallel spec for one parameter leaf: *dense (2-D) kernels*
     shard their output-features axis (column-parallel ``P(None, 'mp')``)
-    when it divides ``mp``; everything else is replicated.
+    when it divides ``mp``; with ``tp_convs`` HWIO conv kernels shard their
+    output-channel axis the same way; everything else is replicated.
 
     Why exactly this layout (verified on the 8-device CPU mesh):
-    - conv-kernel channel sharding is rejected by XLA's SPMD partitioner for
-      this program family — the vmap over tasks becomes a batch-grouped
-      convolution and ``spmd_partitioner`` hard-crashes in
-      ``convolution_handler.cc`` ("Check failed: new_input_batch_size %
-      new_output_batch_size == 0");
+    - on the NATIVE conv path, conv-kernel channel sharding is rejected by
+      XLA's SPMD partitioner for this program family — the vmap over tasks
+      becomes a batch-grouped convolution and ``spmd_partitioner``
+      hard-crashes in ``convolution_handler.cc`` ("Check failed:
+      new_input_batch_size % new_output_batch_size == 0"). ``tp_convs``
+      therefore requires the patches-GEMM conv implementation
+      (``Config.conv_via_patches``, auto-enabled), whose dot_general
+      contraction GSPMD partitions with standard matmul collectives:
+      output-channel (column) sharded kernels produce channel-sharded
+      activations, and the next layer's contraction over its sharded input
+      channels partial-sums against the matching kernel rows (row-parallel),
+      Megatron-style — all inserted automatically;
     - row-parallel (input-axis) dense sharding is unsafe whenever the conv
       stack pools down to 1x1 spatial (the 28x28 4-stage default): the
       flatten reshape is then channel-aligned, the sharding propagates back
-      into the conv output channels, and the same partitioner crash fires;
-    - column-parallel keeps all activations replicated until the logits
-      (dL/dx contracts over the sharded class axis into a psum), so the conv
-      stack never sees a sharded operand.
-    The conv kernels here are <=150KB, so TP buys nothing on them anyway;
-    the dense head is where TP matters as heads widen."""
+      into the conv output channels, and on the native path the same
+      partitioner crash fires;
+    - without ``tp_convs``, column-parallel on the head alone keeps all
+      activations replicated until the logits, so the conv stack never sees
+      a sharded operand.
+    The conv kernels here are <=150KB, so conv TP buys memory/FLOP spread
+    only as backbones widen; the machinery is exercised end-to-end either
+    way (tests/test_parallel.py, __graft_entry__.dryrun_multichip)."""
     if len(shape) == 2 and shape[1] >= mp and shape[1] % mp == 0:
         return P(None, MODEL_AXIS)
+    if tp_convs and len(shape) == 4 and shape[3] >= mp and shape[3] % mp == 0:
+        return P(None, None, None, MODEL_AXIS)
     return P()
 
 
-def train_state_shardings(state, mesh: Mesh):
+def train_state_shardings(state, mesh: Mesh, tp_convs: bool = False):
     """NamedSharding pytree for a ``TrainState``: model parameters and their
     optimizer-moment mirrors are tensor-parallel over ``mp`` (SURVEY.md §2.11
     TP row — pjit param sharding specs on conv/linear weights); everything
@@ -100,7 +112,7 @@ def train_state_shardings(state, mesh: Mesh):
         return jax.tree.map(lambda _: rep, state)
 
     def param_sharding(leaf):
-        return NamedSharding(mesh, _param_spec(tuple(leaf.shape), mp))
+        return NamedSharding(mesh, _param_spec(tuple(leaf.shape), mp, tp_convs))
 
     def opt_spec(path, leaf):
         # the outer optimizer's moment trees (adam mu/nu) mirror the
@@ -119,10 +131,12 @@ def train_state_shardings(state, mesh: Mesh):
     )
 
 
-def shard_train_state(state, mesh: Mesh):
+def shard_train_state(state, mesh: Mesh, tp_convs: bool = False):
     """Place a TrainState pytree onto the mesh with tensor-parallel parameter
     shardings (replicates everything when ``mp == 1``)."""
-    return jax.tree.map(jax.device_put, state, train_state_shardings(state, mesh))
+    return jax.tree.map(
+        jax.device_put, state, train_state_shardings(state, mesh, tp_convs)
+    )
 
 
 def initialize_distributed(

@@ -73,6 +73,50 @@ def test_conv_matches_torch():
     np.testing.assert_allclose(np.asarray(ours), theirs.numpy(), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize(
+    "kh,cin,cout,stride,pad,bias",
+    [
+        (3, 3, 8, 1, 1, True),   # vgg stage (max_pooling path)
+        (3, 8, 8, 2, 1, False),  # vgg/resnet strided stage
+        (1, 8, 4, 1, 0, False),  # densenet bottleneck / transition
+        (1, 8, 4, 2, 0, False),  # resnet downsample shortcut
+        (3, 4, 6, 1, 0, True),   # unpadded case
+    ],
+)
+def test_conv_patches_matches_native(kh, cin, cout, stride, pad, bias):
+    """The patches-GEMM conv (the parallel.tp_convs enabler — see
+    layers.CONV_VIA_PATCHES) is the same math as the native conv for every
+    (kernel, stride, padding) the model zoo uses: forward, kernel grad, and
+    input grad all match to f32 accumulation tolerance."""
+    # pin the process-global conv selector: a conv_via_patches=True
+    # MAMLSystem built by an earlier test would otherwise make conv2d
+    # dispatch to the patches path and turn this into patches-vs-patches
+    prev = layers.CONV_VIA_PATCHES
+    layers.CONV_VIA_PATCHES = False
+    try:
+        _conv_patches_parity_body(kh, cin, cout, stride, pad, bias)
+    finally:
+        layers.CONV_VIA_PATCHES = prev
+
+
+def _conv_patches_parity_body(kh, cin, cout, stride, pad, bias):
+    p = layers.init_conv(jax.random.PRNGKey(0), kh, kh, cin, cout, bias=bias)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 9, 9, cin))
+
+    a = layers.conv2d(p, x, stride=stride, padding=pad)
+    b = layers.conv2d_patches(p, x, stride=stride, padding=pad)
+    assert a.shape == b.shape
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+    ga = jax.grad(lambda w: layers.conv2d({**p, "w": w}, x, stride=stride, padding=pad).sum())(p["w"])
+    gb = jax.grad(lambda w: layers.conv2d_patches({**p, "w": w}, x, stride=stride, padding=pad).sum())(p["w"])
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(gb), rtol=1e-5, atol=1e-5)
+
+    gxa = jax.grad(lambda x: layers.conv2d(p, x, stride=stride, padding=pad).sum())(x)
+    gxb = jax.grad(lambda x: layers.conv2d_patches(p, x, stride=stride, padding=pad).sum())(x)
+    np.testing.assert_allclose(np.asarray(gxa), np.asarray(gxb), rtol=1e-5, atol=1e-5)
+
+
 def test_batch_norm_matches_torch_train_mode():
     rng = np.random.RandomState(1)
     x = rng.randn(4, 5, 5, 7).astype(np.float32)
