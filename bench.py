@@ -192,8 +192,9 @@ class _Watchdog:
         os._exit(rc)
 
     def _watch(self) -> None:
+        poll_s = float(os.environ.get("BENCH_WATCHDOG_POLL_S", "10"))
         while True:
-            time.sleep(10)
+            time.sleep(poll_s)
             if time.monotonic() > self._deadline:
                 self._emit_and_exit(self._stage)
 
