@@ -144,6 +144,12 @@ class _Watchdog:
         self._done = False
         self._deadline = float("inf")
         self._stage = "init"
+        # parse/validate on the main thread: a malformed env value must fail
+        # loudly here, not kill the daemon thread and silently remove the
+        # wedge protection
+        self._poll_s = float(os.environ.get("BENCH_WATCHDOG_POLL_S", "10"))
+        if self._poll_s <= 0:
+            raise ValueError(f"BENCH_WATCHDOG_POLL_S must be > 0, got {self._poll_s}")
         if enabled:
             t = threading.Thread(target=self._watch, daemon=True)
             t.start()
@@ -192,9 +198,8 @@ class _Watchdog:
         os._exit(rc)
 
     def _watch(self) -> None:
-        poll_s = float(os.environ.get("BENCH_WATCHDOG_POLL_S", "10"))
         while True:
-            time.sleep(poll_s)
+            time.sleep(self._poll_s)
             if time.monotonic() > self._deadline:
                 self._emit_and_exit(self._stage)
 

@@ -1,0 +1,81 @@
+#!/bin/bash
+# Post-queue chip work, serialized behind scripts/round4_queue.sh (the
+# tunnel is single-client): first the short donation-probe determinism
+# control (selfcheck), then — ONLY if the sweep's donation-off 20-way
+# fix-verification rows early-aborted (rc=3 ⇒ the donation fix did not
+# cure the collapse) — the remaining 3-epoch diagnostic arms the cut chain
+# would have run (X3 matmul_precision=high, X7 rolled+remat), so the round
+# still leaves with a discriminating on-chip result for round 5.
+#
+# Usage: scripts/postqueue_watch.sh <queue_pid> [deadline_epoch]
+set -u
+cd /root/repo
+QPID=${1:-}
+# don't START multi-hour arms inside the driver's end-of-round window
+DEADLINE_EPOCH=${2:-$(( $(date +%s) + 10 * 3600 ))}
+LOG=results/r4/postqueue.log
+mkdir -p results/r4 exps/diag
+if [ -n "$QPID" ]; then
+  # same PID-recycling guard as round4_queue.sh
+  while kill -0 "$QPID" 2>/dev/null \
+      && grep -aq round4_queue "/proc/$QPID/cmdline" 2>/dev/null; do
+    sleep 120
+  done
+fi
+echo "=== $(date -u +%H:%M:%S) queue gone; gating on tunnel" >> "$LOG"
+python -u scripts/wait_for_tpu.py 7200 60 >> "$LOG" 2>&1 || {
+  echo "=== $(date -u +%H:%M:%S) tunnel gate deadline, nothing run" >> "$LOG"
+  exit 1
+}
+
+echo "=== $(date -u +%H:%M:%S) [1/2] donation selfcheck (determinism control)" >> "$LOG"
+timeout --kill-after=30 1800 python -u scripts/donation_probe.py selfcheck 40 20 5 8 \
+  >> results/r4/donation_selfcheck.log 2>&1
+echo "=== $(date -u +%H:%M:%S) selfcheck rc=$?" >> "$LOG"
+
+# Did the fix-verification rows abort? runner prints '— diverged' and exits
+# rc=3; sweep.sh logs 'EARLY-ABORTED'. Check the run logs themselves (the
+# runner's message survives resumes; sweep log is exps/-volatile).
+aborted=0
+for f in exps/omniglot.20.5.vgg.gd.nodonate.0.out exps/omniglot.20.1.vgg.gd.nodonate.0.out; do
+  grep -q "diverged" "$f" 2>/dev/null && aborted=$((aborted + 1))
+done
+if [ "$aborted" -eq 0 ]; then
+  echo "=== $(date -u +%H:%M:%S) nodonate rows did not abort — no fallback arms needed" >> "$LOG"
+  exit 0
+fi
+if [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; then
+  echo "=== $(date -u +%H:%M:%S) fallback arms needed ($aborted aborts) but deadline passed" >> "$LOG"
+  exit 1
+fi
+echo "=== $(date -u +%H:%M:%S) [2/2] $aborted nodonate rows aborted — running X3/X7 arms" >> "$LOG"
+COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
+ dataset.path=/root/reference/datasets/omniglot_dataset \
+ index_cache_dir=/tmp/omniglot_idx load_into_memory=true \
+ num_classes_per_set=20 num_samples_per_class=5 net=vgg total_epochs=3 \
+ experiment_root=exps/diag"
+python -u scripts/wait_for_tpu.py 3600 60 >> "$LOG" 2>&1 || {
+  echo "=== $(date -u +%H:%M:%S) gate deadline before X3, aborting" >> "$LOG"; exit 1; }
+timeout --kill-after=30 2400 python -u train_maml_system.py $COMMON \
+  remat_inner_steps=false matmul_precision=high experiment_name=X3.high \
+  >> "$LOG" 2>&1
+echo "=== X3 rc=$?" >> "$LOG"
+if [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; then
+  echo "=== $(date -u +%H:%M:%S) deadline passed after X3, skipping X7" >> "$LOG"
+else
+python -u scripts/wait_for_tpu.py 3600 60 >> "$LOG" 2>&1 || {
+  echo "=== $(date -u +%H:%M:%S) gate deadline before X7, aborting" >> "$LOG"; exit 1; }
+timeout --kill-after=30 2400 python -u train_maml_system.py $COMMON \
+  remat_inner_steps=true unroll_inner_steps=false experiment_name=X7.rolled \
+  >> "$LOG" 2>&1
+echo "=== X7 rc=$?" >> "$LOG"
+fi
+# durable copies of the arm logs
+for d in exps/diag/X3.high exps/diag/X7.rolled; do
+  [ -d "$d/logs" ] || continue
+  n=$(basename "$d")
+  mkdir -p "results/r4/diag/$n"
+  cp -f "$d"/config.yaml "$d"/lrs.csv "results/r4/diag/$n/" 2>/dev/null
+  cp -rf "$d"/logs "results/r4/diag/$n/" 2>/dev/null
+done
+echo "=== $(date -u +%H:%M:%S) postqueue watch done" >> "$LOG"

@@ -104,9 +104,16 @@ time.sleep(60)
         cwd=REPO,
         env=ENV,
     )
-    # wait for the handler to be installed before terming
+    # wait for the handler to be installed before terming; select keeps the
+    # deadline real (a bare readline() would block past it if the child
+    # stalls before printing READY)
+    import select
+
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
+        ready, _, _ = select.select([p.stderr], [], [], 1.0)
+        if not ready:
+            continue
         line = p.stderr.readline()
         if "READY" in line or line == "":  # '' = EOF: child died early
             break
