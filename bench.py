@@ -146,10 +146,16 @@ class _Watchdog:
         self._stage = "init"
         # parse/validate on the main thread: a malformed env value must fail
         # loudly here, not kill the daemon thread and silently remove the
-        # wedge protection
-        self._poll_s = float(os.environ.get("BENCH_WATCHDOG_POLL_S", "10"))
+        # wedge protection — and "loudly" must still honor the one-JSON-line
+        # driver contract (a bare raise here would precede the excepthook
+        # installed later in main())
+        raw_poll = os.environ.get("BENCH_WATCHDOG_POLL_S", "10")
+        try:
+            self._poll_s = float(raw_poll)
+        except ValueError:
+            self._poll_s = -1.0
         if self._poll_s <= 0:
-            raise ValueError(f"BENCH_WATCHDOG_POLL_S must be > 0, got {self._poll_s}")
+            _fail(f"BENCH_WATCHDOG_POLL_S must be a positive number, got {raw_poll!r}")
         if enabled:
             t = threading.Thread(target=self._watch, daemon=True)
             t.start()

@@ -103,7 +103,18 @@ def adam(lr: float = 0.1, beta1: float = 0.5, beta2: float = 0.5, eps: float = 1
             v = b2 * v + (1.0 - b2) * g * g
             bc1 = 1.0 - b1**t
             bc2 = 1.0 - b2**t
-            denom = jnp.sqrt(v) / jnp.sqrt(bc2) + eps
+            # sqrt is clamped away from 0 because this update must be
+            # twice-differentiable: at the first inner step v = (1-b2)*g^2,
+            # and any parameter element with an EXACTLY zero inner grad
+            # (real on Omniglot — kernel taps that only ever see constant
+            # background) puts sqrt'(0) = inf into the second-order
+            # meta-gradient, where inf * 0 = NaN then poisons the first
+            # outer update (observed: every loss after iteration 0 NaN,
+            # betas.csv all-NaN). Forward-identical to torch.optim.Adam at
+            # f32: sqrt(1e-24) = 1e-12, three orders below eps; backward
+            # takes the (correct) zero subgradient of the clamp's flat
+            # branch instead of inf.
+            denom = jnp.sqrt(jnp.maximum(v, 1e-24)) / jnp.sqrt(bc2) + eps
             p = p - (a / bc1) * m / denom
             return p, m, v, t
 

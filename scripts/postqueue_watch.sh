@@ -34,16 +34,24 @@ timeout --kill-after=30 1800 python -u scripts/donation_probe.py selfcheck 40 20
 echo "=== $(date -u +%H:%M:%S) selfcheck rc=$?" >> "$LOG"
 
 # Did the fix-verification rows abort? runner prints '— diverged' and exits
-# rc=3; sweep.sh logs 'EARLY-ABORTED'. Check the run logs themselves (the
-# runner's message survives resumes; sweep log is exps/-volatile).
-aborted=0
+# rc=3; a COMPLETED row prints its final test dict ('test_accuracy_mean').
+# Distinguish three outcomes per row: aborted / completed / absent-or-
+# incomplete (never started, or died to wedges) — only "both completed"
+# means the donation fix is verified and the fallback arms are unneeded.
+aborted=0; completed=0
 for f in exps/omniglot.20.5.vgg.gd.nodonate.0.out exps/omniglot.20.1.vgg.gd.nodonate.0.out; do
-  grep -q "diverged" "$f" 2>/dev/null && aborted=$((aborted + 1))
+  if grep -q "diverged" "$f" 2>/dev/null; then aborted=$((aborted + 1))
+  elif grep -q "test_accuracy_mean" "$f" 2>/dev/null; then completed=$((completed + 1))
+  fi
 done
-if [ "$aborted" -eq 0 ]; then
-  echo "=== $(date -u +%H:%M:%S) nodonate rows did not abort — no fallback arms needed" >> "$LOG"
+echo "=== $(date -u +%H:%M:%S) nodonate rows: aborted=$aborted completed=$completed" >> "$LOG"
+if [ "$aborted" -eq 0 ] && [ "$completed" -eq 2 ]; then
+  echo "=== $(date -u +%H:%M:%S) donation fix verified — no fallback arms needed" >> "$LOG"
   exit 0
 fi
+# aborted>0: fix refuted, the arms discriminate the remaining suspects.
+# absent/incomplete rows: undecided — the 3-epoch arms are far cheaper than
+# full rows, so still worth a deadline-gated attempt.
 if [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; then
   echo "=== $(date -u +%H:%M:%S) fallback arms needed ($aborted aborts) but deadline passed" >> "$LOG"
   exit 1

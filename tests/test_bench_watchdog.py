@@ -104,19 +104,23 @@ time.sleep(60)
         cwd=REPO,
         env=ENV,
     )
-    # wait for the handler to be installed before terming; select keeps the
-    # deadline real (a bare readline() would block past it if the child
-    # stalls before printing READY)
+    # wait for the handler to be installed before terming; raw os.read on
+    # the fd keeps the deadline real (select + buffered readline would
+    # strand READY inside the TextIOWrapper buffer and stall to the
+    # deadline; a bare readline() would block past it entirely)
     import select
 
+    fd = p.stderr.fileno()
+    seen = ""
     deadline = time.monotonic() + 30
-    while time.monotonic() < deadline:
-        ready, _, _ = select.select([p.stderr], [], [], 1.0)
+    while time.monotonic() < deadline and "READY" not in seen:
+        ready, _, _ = select.select([fd], [], [], 1.0)
         if not ready:
             continue
-        line = p.stderr.readline()
-        if "READY" in line or line == "":  # '' = EOF: child died early
+        chunk = os.read(fd, 4096).decode(errors="replace")
+        if chunk == "":  # EOF: child died early
             break
+        seen += chunk
     p.send_signal(signal.SIGTERM)
     out, _ = p.communicate(timeout=30)
     assert p.returncode == 0

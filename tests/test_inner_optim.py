@@ -106,6 +106,35 @@ def test_adam_betas_differentiable():
     assert np.isfinite(float(g)) and abs(float(g)) > 0
 
 
+def test_adam_second_order_finite_at_zero_grad_elements():
+    """Regression: second-order meta-grads through the FIRST adam inner step
+    must be finite even for parameter elements whose inner gradient is
+    exactly zero (real on Omniglot — kernel taps that only ever see constant
+    background). exp_avg_sq starts at 0 there, and an unclamped sqrt makes
+    sqrt'(0) = inf appear in the backward, where inf * 0 = NaN poisoned the
+    first outer update (observed in the round-4 CPU smoke: every loss after
+    iteration 0 NaN, betas.csv all-NaN)."""
+    opt = build_inner_optimizer("adam", lr=0.1, beta1=0.5, beta2=0.5)
+
+    def meta_loss(p):
+        # inner loss touches only w[0]; w[1]'s inner grad is exactly 0
+        def inner_loss(q):
+            return q["w"][0] ** 2
+
+        g = jax.grad(inner_loss)(p)
+        hparams = opt.init_hparams(p)
+        state = opt.init_state(p, hparams)
+        p1, _ = opt.update(g, state, p, hparams)
+        return jnp.sum(p1["w"] ** 2)
+
+    params = {"w": jnp.array([0.7, -0.3])}
+    g = jax.grad(meta_loss)(params)
+    assert np.all(np.isfinite(np.asarray(g["w"]))), g
+    # and the forward math is unchanged where grads are nonzero
+    loss = meta_loss(params)
+    assert np.isfinite(float(loss))
+
+
 def test_projection():
     opt = build_inner_optimizer("adam")
     h = {
