@@ -254,6 +254,11 @@ def main():
         "unit": "meta-steps/sec/chip",
         "vs_baseline": None,
         "platform": f"{platform}:{device_kind}",
+        # program-variant markers: a capture from an A/B arm must never read
+        # as (or be compared against) the flagship native-conv/default-
+        # precision number without saying so
+        "matmul_precision": os.environ.get("BENCH_MATMUL_PRECISION", "default"),
+        "conv_via_patches": os.environ.get("BENCH_CONV_VIA_PATCHES", "0") == "1",
     }
     wd = _Watchdog(report, enabled=platform != "cpu")
     import signal
@@ -305,10 +310,15 @@ def main():
     # BENCH_MATMUL_PRECISION quantifies the throughput cost of raising MXU
     # precision (the 20-way-collapse fix candidate runs f32 configs at
     # 'high'): same flagship program, different dot/conv pass count.
+    # BENCH_CONV_VIA_PATCHES=1 A/Bs the patches-GEMM conv (the tp_convs
+    # enabler) on a single chip: same math, explicit im2col + dot instead of
+    # the native conv — quantifies what the TP-capable program family costs
+    # (or saves) when the MXU runs the GEMM explicitly.
     cfg = Config(
         compute_dtype="bfloat16",
         remat_inner_steps=False,
         matmul_precision=os.environ.get("BENCH_MATMUL_PRECISION", "default"),
+        conv_via_patches=os.environ.get("BENCH_CONV_VIA_PATCHES", "0") == "1",
     )
     system = MAMLSystem(cfg)
     state = system.init_train_state()
