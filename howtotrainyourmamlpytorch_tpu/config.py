@@ -145,7 +145,7 @@ class Config:
                 f"train_steps_per_dispatch must be >= 1, "
                 f"got {self.train_steps_per_dispatch}"
             )
-        if getattr(self.parallel, "tp_convs", False) and not self.conv_via_patches:
+        if self.parallel.tp_convs and not self.conv_via_patches:
             # tp_convs is meaningless (and partitioner-fatal) on the native
             # conv path; the patches-GEMM form is a strict requirement, so
             # enable it rather than bounce the config back

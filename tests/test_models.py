@@ -83,7 +83,7 @@ def test_conv_matches_torch():
         (3, 4, 6, 1, 0, True),   # unpadded case
     ],
 )
-def test_conv_patches_matches_native(kh, cin, cout, stride, pad, bias):
+def test_conv_patches_matches_native(kh, cin, cout, stride, pad, bias, monkeypatch):
     """The patches-GEMM conv (the parallel.tp_convs enabler — see
     layers.CONV_VIA_PATCHES) is the same math as the native conv for every
     (kernel, stride, padding) the model zoo uses: forward, kernel grad, and
@@ -91,15 +91,7 @@ def test_conv_patches_matches_native(kh, cin, cout, stride, pad, bias):
     # pin the process-global conv selector: a conv_via_patches=True
     # MAMLSystem built by an earlier test would otherwise make conv2d
     # dispatch to the patches path and turn this into patches-vs-patches
-    prev = layers.CONV_VIA_PATCHES
-    layers.CONV_VIA_PATCHES = False
-    try:
-        _conv_patches_parity_body(kh, cin, cout, stride, pad, bias)
-    finally:
-        layers.CONV_VIA_PATCHES = prev
-
-
-def _conv_patches_parity_body(kh, cin, cout, stride, pad, bias):
+    monkeypatch.setattr(layers, "CONV_VIA_PATCHES", False)
     p = layers.init_conv(jax.random.PRNGKey(0), kh, kh, cin, cout, bias=bias)
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 9, 9, cin))
 
