@@ -437,6 +437,18 @@ def main():
                 break
     except Exception as e:
         print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)
+    if flops_hlo:
+        # provisional MFU goes into the report NOW: a wedge in the (riskier)
+        # trace/b16 arms below must not cost the capture its mfu when the
+        # HLO FLOPs are already known; the trace-based numbers refine it in
+        # the final report
+        peak0 = _peak_flops(device_kind)
+        wd.update(
+            flops_per_step=flops_hlo,
+            flops_source="hlo",
+            peak_flops_per_sec=peak0,
+            mfu=(round(flops_hlo * steps_per_sec / peak0, 5) if peak0 else None),
+        )
 
     # --- device-time breakdown + measured FLOPs from a short jax.profiler
     # trace (per-op flops + hlo_category + chip peak are in the xplane). ---
@@ -468,6 +480,19 @@ def main():
             breakdown.pop("model_flops_total", None)
     except Exception as e:
         print(f"bench: profile breakdown unavailable: {e}", file=sys.stderr)
+    if flops_measured or breakdown:
+        # persist the trace refinement immediately for the same reason as
+        # the provisional HLO mfu above: a wedge in the b16 arm must not
+        # discard a completed trace
+        _fps = flops_measured or flops_hlo
+        _peak = trace_peak or _peak_flops(device_kind)
+        wd.update(
+            flops_per_step=_fps,
+            flops_source="trace" if flops_measured else ("hlo" if flops_hlo else None),
+            peak_flops_per_sec=_peak,
+            mfu=(round(_fps * steps_per_sec / _peak, 5) if _fps and _peak else None),
+            breakdown=breakdown,
+        )
 
     # Batch-scaling arm (DESIGN.md §6 roofline: a bigger meta-batch raises
     # the implicit-GEMM M rows; K/N MXU occupancy unchanged — does task
