@@ -73,7 +73,7 @@ class ParallelConfig:
     # conv implementation (Config.conv_via_patches, auto-enabled): GSPMD's
     # convolution handler hard-crashes on this program family's sharded
     # convs, a dot_general contraction partitions fine (models/layers.py
-    # CONV_VIA_PATCHES note, parallel/mesh.py::_param_spec).
+    # conv2d ``via_patches`` note, parallel/mesh.py::_param_spec).
     tp_convs: bool = False
 
     def __post_init__(self):
@@ -276,7 +276,7 @@ class Config:
     max_pool_reduce_window: bool = False
     # Express every conv as patch-extraction + dot_general (implicit GEMM
     # made explicit; same math up to accumulation order). The enabler for
-    # parallel.tp_convs — see models/layers.py CONV_VIA_PATCHES — and
+    # parallel.tp_convs — see models/layers.py conv2d ``via_patches`` — and
     # auto-enabled by it; usable standalone for A/B perf or numerics probes.
     conv_via_patches: bool = False
     # Early divergence abort (sweep-time guard; 0.0 disables): exit with

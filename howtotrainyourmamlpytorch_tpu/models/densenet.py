@@ -35,13 +35,13 @@ def _init_dense_layer(key, cin, growth_rate, bn_size):
     return params, state
 
 
-def _apply_dense_layer(params, state, x, use_batch_stats, update_running):
+def _apply_dense_layer(params, state, x, use_batch_stats, update_running, via_patches=False):
     out, n1_s = layers.batch_norm(params["norm1"], state["norm1"], x, use_batch_stats, update_running)
     out = layers.relu(out)
-    out = layers.conv2d(params["conv1"], out, stride=1, padding=0)
+    out = layers.conv2d(params["conv1"], out, stride=1, padding=0, via_patches=via_patches)
     out, n2_s = layers.batch_norm(params["norm2"], state["norm2"], out, use_batch_stats, update_running)
     out = layers.relu(out)
-    out = layers.conv2d(params["conv2"], out, stride=1, padding=1)
+    out = layers.conv2d(params["conv2"], out, stride=1, padding=1, via_patches=via_patches)
     return out, {"norm1": n1_s, "norm2": n2_s}
 
 
@@ -51,7 +51,11 @@ def build_densenet(
     block_config: Sequence[int] = (3, 3, 3, 3),
     growth_rate: int = 8,
     bn_size: int = 2,
+    conv_via_patches: bool = False,
 ) -> Model:
+    """``conv_via_patches`` bakes the conv implementation into this model's
+    apply (explicit parameter, not a process global — see layers.conv2d).
+    No max-pool knob: transitions use average pooling."""
     h, w, c = image_shape
 
     def init(key):
@@ -101,7 +105,7 @@ def build_densenet(
                 lname = f"layer_{li}"
                 new_feat, ls = _apply_dense_layer(
                     params[bname][lname], state[bname][lname], x,
-                    use_batch_stats, update_running,
+                    use_batch_stats, update_running, conv_via_patches,
                 )
                 block_s[lname] = ls
                 x = jnp.concatenate([x, new_feat], axis=-1)
@@ -113,7 +117,10 @@ def build_densenet(
                     use_batch_stats, update_running,
                 )
                 x = layers.relu(x)
-                x = layers.conv2d(params[tname]["conv"], x, stride=1, padding=0)
+                x = layers.conv2d(
+                    params[tname]["conv"], x, stride=1, padding=0,
+                    via_patches=conv_via_patches,
+                )
                 x = layers.avg_pool(x)
                 new_state[tname] = {"norm": tn_s}
         x, n5_s = layers.batch_norm(params["norm5"], state["norm5"], x, use_batch_stats, update_running)
@@ -122,4 +129,8 @@ def build_densenet(
         x = layers.global_avg_pool(x)
         return layers.linear(params["classifier"], x), new_state
 
-    return Model(init=init, apply=apply, name="densenet")
+    # reduce_window_pool=None: transitions use average pooling, so the
+    # max-pool tie-subgradient convention does not apply
+    return Model(
+        init=init, apply=apply, name="densenet", conv_via_patches=conv_via_patches
+    )

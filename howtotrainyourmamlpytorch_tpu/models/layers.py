@@ -91,11 +91,10 @@ def init_conv(key, kh, kw, cin, cout, bias=True, init="torch_default"):
     return params
 
 
-# Tensor-parallel enabler (Config.conv_via_patches, set by MAMLSystem like
-# FORCE_REDUCE_WINDOW_POOL above): route every conv through patch extraction
-# + dot_general instead of lax.conv_general_dilated. Trace-time static, same
-# flip-warning caveat. Why it exists: XLA's GSPMD partitioner hard-crashes in
-# convolution_handler.cc on this program family when conv operands carry
+# Why a patches-GEMM conv exists at all (``conv2d(..., via_patches=True)``,
+# threaded from Config.conv_via_patches by the model builders — a per-model
+# build parameter, not process state): XLA's GSPMD partitioner hard-crashes
+# in convolution_handler.cc on this program family when conv operands carry
 # ``mp`` shardings (the vmap over per-task adapted kernels becomes a
 # batch-grouped convolution; see parallel/mesh.py::_param_spec). A dot_general
 # contraction has no such handler limits — GSPMD partitions it with the
@@ -103,7 +102,6 @@ def init_conv(key, kh, kw, cin, cout, bias=True, init="torch_default"):
 # lets conv kernels shard over ``mp`` (output-channel / Megatron column style)
 # with activations gathered/partial-summed automatically. On TPU the MXU
 # executes convs as implicit GEMM anyway; this makes the GEMM explicit.
-CONV_VIA_PATCHES = None
 
 
 def extract_patches(x, kh, kw, stride=1, padding=0):
@@ -154,9 +152,13 @@ def conv2d_patches(params, x, stride=1, padding=0):
     return out
 
 
-def conv2d(params, x, stride=1, padding=0):
-    """3x3/1x1 conv, NHWC. ``padding`` is symmetric int (torch-style)."""
-    if CONV_VIA_PATCHES:
+def conv2d(params, x, stride=1, padding=0, *, via_patches=False):
+    """3x3/1x1 conv, NHWC. ``padding`` is symmetric int (torch-style).
+
+    ``via_patches`` selects the implementation per call (the model builders
+    thread Config.conv_via_patches here explicitly — see the patches-GEMM
+    rationale above :func:`extract_patches`)."""
+    if via_patches:
         return conv2d_patches(params, x, stride, padding)
     pad = ((padding, padding), (padding, padding)) if isinstance(padding, int) else padding
     out = lax.conv_general_dilated(
@@ -243,20 +245,7 @@ def batch_norm(
 # ---------------------------------------------------------------------------
 
 
-# Escape hatch for on-chip parity debugging: force the lax.reduce_window
-# path (select_and_scatter backward == torch's first-argmax tie subgradient)
-# even for non-overlapping pools. Set from Config.max_pool_reduce_window by
-# MAMLSystem.__init__; module-level because the model zoo calls
-# ``layers.max_pool`` directly. Trace-time static — baked into each compiled
-# program at trace time, so flip it before constructing the system. None =
-# not yet configured (treated as False); MAMLSystem warns when a system's
-# config flips an already-configured different value (the flag is not part
-# of any compile-cache key, so a mid-process flip changes what OTHER live
-# systems bake into programs they trace afterwards).
-FORCE_REDUCE_WINDOW_POOL = None
-
-
-def max_pool(x, window=2, stride=2):
+def max_pool(x, window=2, stride=2, *, force_reduce_window=False):
     """MaxPool2d(window, stride, pad=0), floor mode — matches torch default.
 
     Non-overlapping pools (window == stride, the only case the model zoo
@@ -272,12 +261,13 @@ def max_pool(x, window=2, stride=2):
     f32 training, BUT under bfloat16 compute (8-bit mantissa) tied window
     maxima are plausible after quantization, so in the mixed-precision
     regime this is a real gradient-level deviation from the reference's
-    torch convention. ``Config.max_pool_reduce_window=true`` (module flag
-    ``FORCE_REDUCE_WINDOW_POOL``) forces the reduce_window path so the
+    torch convention. ``Config.max_pool_reduce_window=true`` (threaded here
+    as ``force_reduce_window`` by the model builders — a per-model build
+    parameter, not process state) forces the reduce_window path so the
     convention can be ruled in/out during on-chip parity debugging; see
     PARITY.md.
     """
-    if window == stride and not FORCE_REDUCE_WINDOW_POOL:
+    if window == stride and not force_reduce_window:
         b, h, w, c = x.shape
         ho, wo = h // window, w // window
         x = x[:, : ho * window, : wo * window, :]

@@ -18,8 +18,20 @@ _DENSENET_BLOCKS = {"densenet-8": (2, 2, 2, 2), "densenet-12": (3, 3, 3, 3)}
 MODEL_NAMES = ("vgg",) + tuple(_RESNET_BLOCKS) + tuple(_DENSENET_BLOCKS)
 
 
-def build_model(net: str, image_shape: Tuple[int, int, int], num_classes: int) -> Model:
-    """``image_shape`` is (H, W, C) — NHWC, the TPU-native layout."""
+def build_model(
+    net: str,
+    image_shape: Tuple[int, int, int],
+    num_classes: int,
+    conv_via_patches: bool = False,
+    reduce_window_pool: bool = False,
+) -> Model:
+    """``image_shape`` is (H, W, C) — NHWC, the TPU-native layout.
+
+    ``conv_via_patches`` (Config.conv_via_patches, the parallel.tp_convs
+    enabler) and ``reduce_window_pool`` (Config.max_pool_reduce_window) are
+    baked into the returned model's ``apply`` — explicit per-model
+    parameters, not process globals, so concurrently-live systems trace
+    independent conventions."""
     if net == "vgg":
         return build_vgg(
             image_shape,
@@ -29,9 +41,17 @@ def build_model(net: str, image_shape: Tuple[int, int, int], num_classes: int) -
             max_pooling=True,
             conv_padding=True,
             norm_layer="batch_norm",
+            conv_via_patches=conv_via_patches,
+            reduce_window_pool=reduce_window_pool,
         )
     if net in _RESNET_BLOCKS:
-        return build_resnet(image_shape, num_classes, blocks_per_stage=_RESNET_BLOCKS[net])
+        return build_resnet(
+            image_shape, num_classes, blocks_per_stage=_RESNET_BLOCKS[net],
+            conv_via_patches=conv_via_patches,
+        )
     if net in _DENSENET_BLOCKS:
-        return build_densenet(image_shape, num_classes, block_config=_DENSENET_BLOCKS[net])
+        return build_densenet(
+            image_shape, num_classes, block_config=_DENSENET_BLOCKS[net],
+            conv_via_patches=conv_via_patches,
+        )
     raise ValueError(f"unknown net {net!r}; expected one of {MODEL_NAMES}")

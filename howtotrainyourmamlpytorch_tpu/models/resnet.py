@@ -44,16 +44,21 @@ def _init_basic_block(key, cin, planes, stride):
     return params, state
 
 
-def _apply_basic_block(params, state, x, stride, use_batch_stats, update_running):
+def _apply_basic_block(
+    params, state, x, stride, use_batch_stats, update_running, via_patches=False
+):
     identity = x
-    out = layers.conv2d(params["conv1"], x, stride=stride, padding=1)
+    out = layers.conv2d(params["conv1"], x, stride=stride, padding=1, via_patches=via_patches)
     out, bn1_s = layers.batch_norm(params["bn1"], state["bn1"], out, use_batch_stats, update_running)
     out = layers.relu(out)
-    out = layers.conv2d(params["conv2"], out, stride=1, padding=1)
+    out = layers.conv2d(params["conv2"], out, stride=1, padding=1, via_patches=via_patches)
     out, bn2_s = layers.batch_norm(params["bn2"], state["bn2"], out, use_batch_stats, update_running)
     new_state = {"bn1": bn1_s, "bn2": bn2_s}
     if "downsample" in params:
-        identity = layers.conv2d(params["downsample"]["conv"], x, stride=stride, padding=0)
+        identity = layers.conv2d(
+            params["downsample"]["conv"], x, stride=stride, padding=0,
+            via_patches=via_patches,
+        )
         identity, dbn_s = layers.batch_norm(
             params["downsample"]["bn"], state["downsample"]["bn"], identity,
             use_batch_stats, update_running,
@@ -67,7 +72,11 @@ def build_resnet(
     num_classes: int,
     blocks_per_stage: Sequence[int] = (1, 1, 1, 1),
     zero_init_residual: bool = False,
+    conv_via_patches: bool = False,
 ) -> Model:
+    """``conv_via_patches`` bakes the conv implementation into this model's
+    apply (explicit parameter, not a process global — see layers.conv2d).
+    No pooling knob: the only pools here are global-average."""
     h, w, c = image_shape
 
     def init(key):
@@ -102,11 +111,15 @@ def build_resnet(
                 stride = 2 if bi == 0 else 1
                 x, bs = _apply_basic_block(
                     params[lname][bname], state[lname][bname], x, stride,
-                    use_batch_stats, update_running,
+                    use_batch_stats, update_running, conv_via_patches,
                 )
                 stage_s[bname] = bs
             new_state[lname] = stage_s
         x = layers.global_avg_pool(x)
         return layers.linear(params["fc"], x), new_state
 
-    return Model(init=init, apply=apply, name="resnet")
+    # reduce_window_pool=None: no max-pooling in this backbone (global
+    # average pools only), so the convention does not apply
+    return Model(
+        init=init, apply=apply, name="resnet", conv_via_patches=conv_via_patches
+    )

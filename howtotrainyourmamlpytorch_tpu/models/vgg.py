@@ -24,7 +24,13 @@ def build_vgg(
     max_pooling: bool = True,
     conv_padding: bool = True,
     norm_layer: str = "batch_norm",
+    conv_via_patches: bool = False,
+    reduce_window_pool: bool = False,
 ) -> Model:
+    """``conv_via_patches`` / ``reduce_window_pool`` bake the conv
+    implementation and pooling tie-subgradient convention into THIS model's
+    apply (explicit parameters, not process globals — each model's traced
+    programs carry their own conventions; see layers.conv2d / layers.max_pool)."""
     if norm_layer != "batch_norm":
         raise ValueError("only batch_norm is supported (reference models.py:38-41)")
     h, w, c = image_shape
@@ -36,14 +42,17 @@ def build_vgg(
         for i in range(num_stages):
             name = f"stage_{i}"
             p = params[name]
-            x = layers.conv2d(p["conv"], x, stride=conv_stride, padding=pad)
+            x = layers.conv2d(
+                p["conv"], x, stride=conv_stride, padding=pad,
+                via_patches=conv_via_patches,
+            )
             x, bn_state = layers.batch_norm(
                 p["bn"], state[name]["bn"], x, use_batch_stats, update_running
             )
             new_state[name] = {"bn": bn_state}
             x = layers.leaky_relu(x)
             if max_pooling:
-                x = layers.max_pool(x)
+                x = layers.max_pool(x, force_reduce_window=reduce_window_pool)
         return x, new_state
 
     def init(key):
@@ -72,4 +81,11 @@ def build_vgg(
         x = layers.flatten(x)
         return layers.linear(params["fc"], x), new_state
 
-    return Model(init=init, apply=apply, name="vgg")
+    return Model(
+        init=init,
+        apply=apply,
+        name="vgg",
+        conv_via_patches=conv_via_patches,
+        # pooling convention only applies when the backbone actually pools
+        reduce_window_pool=reduce_window_pool if max_pooling else None,
+    )

@@ -39,9 +39,9 @@ def apply_mxu_default_emulation():
     def r(a):
         return a.astype(jnp.bfloat16).astype(jnp.float32)
 
-    def conv2d_bf16_operands(params, x, stride=1, padding=0):
+    def conv2d_bf16_operands(params, x, stride=1, padding=0, *, via_patches=False):
         p = dict(params, w=r(params["w"]))
-        return orig_conv2d(p, r(x), stride=stride, padding=padding)
+        return orig_conv2d(p, r(x), stride=stride, padding=padding, via_patches=via_patches)
 
     def linear_bf16_operands(params, x):
         return r(x) @ r(params["w"]) + params["b"]

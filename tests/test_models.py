@@ -83,28 +83,26 @@ def test_conv_matches_torch():
         (3, 4, 6, 1, 0, True),   # unpadded case
     ],
 )
-def test_conv_patches_matches_native(kh, cin, cout, stride, pad, bias, monkeypatch):
+def test_conv_patches_matches_native(kh, cin, cout, stride, pad, bias):
     """The patches-GEMM conv (the parallel.tp_convs enabler — see
-    layers.CONV_VIA_PATCHES) is the same math as the native conv for every
-    (kernel, stride, padding) the model zoo uses: forward, kernel grad, and
-    input grad all match to f32 accumulation tolerance."""
-    # pin the process-global conv selector: a conv_via_patches=True
-    # MAMLSystem built by an earlier test would otherwise make conv2d
-    # dispatch to the patches path and turn this into patches-vs-patches
-    monkeypatch.setattr(layers, "CONV_VIA_PATCHES", False)
+    layers.conv2d ``via_patches``) is the same math as the native conv for
+    every (kernel, stride, padding) the model zoo uses: forward, kernel grad,
+    and input grad all match to f32 accumulation tolerance."""
     p = layers.init_conv(jax.random.PRNGKey(0), kh, kh, cin, cout, bias=bias)
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 9, 9, cin))
 
-    a = layers.conv2d(p, x, stride=stride, padding=pad)
+    # explicit via_patches=False pins the native arm regardless of the
+    # module-level default (nothing mutates it anymore, but be self-evident)
+    a = layers.conv2d(p, x, stride=stride, padding=pad, via_patches=False)
     b = layers.conv2d_patches(p, x, stride=stride, padding=pad)
     assert a.shape == b.shape
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
 
-    ga = jax.grad(lambda w: layers.conv2d({**p, "w": w}, x, stride=stride, padding=pad).sum())(p["w"])
+    ga = jax.grad(lambda w: layers.conv2d({**p, "w": w}, x, stride=stride, padding=pad, via_patches=False).sum())(p["w"])
     gb = jax.grad(lambda w: layers.conv2d_patches({**p, "w": w}, x, stride=stride, padding=pad).sum())(p["w"])
     np.testing.assert_allclose(np.asarray(ga), np.asarray(gb), rtol=1e-5, atol=1e-5)
 
-    gxa = jax.grad(lambda x: layers.conv2d(p, x, stride=stride, padding=pad).sum())(x)
+    gxa = jax.grad(lambda x: layers.conv2d(p, x, stride=stride, padding=pad, via_patches=False).sum())(x)
     gxb = jax.grad(lambda x: layers.conv2d_patches(p, x, stride=stride, padding=pad).sum())(x)
     np.testing.assert_allclose(np.asarray(gxa), np.asarray(gxb), rtol=1e-5, atol=1e-5)
 
@@ -227,34 +225,72 @@ def test_max_pool_reduce_window_escape_hatch():
     select_and_scatter backward uses torch's first-argmax tie subgradient —
     the escape hatch for ruling the pooling convention in/out under bf16
     quantization (ADVICE r3; max_pool docstring)."""
-    from howtotrainyourmamlpytorch_tpu.config import Config
-
     x_all_tied = jnp.ones((1, 2, 2, 1), np.float32)
-    prev = layers.FORCE_REDUCE_WINDOW_POOL
-    try:
-        layers.FORCE_REDUCE_WINDOW_POOL = True
-        g = jax.grad(lambda a: jnp.sum(layers.max_pool(a)))(x_all_tied)
-        expected = np.zeros((1, 2, 2, 1), np.float32)
-        expected[0, 0, 0, 0] = 1.0  # all gradient to the first argmax
-        np.testing.assert_allclose(np.asarray(g), expected)
-        # tie-free forward unchanged
-        rng = np.random.RandomState(0)
-        xc = jnp.asarray(rng.randn(1, 8, 8, 2).astype(np.float32))
-        forced = layers.max_pool(xc)
-        layers.FORCE_REDUCE_WINDOW_POOL = False
-        np.testing.assert_allclose(forced, layers.max_pool(xc), rtol=0, atol=0)
-    finally:
-        layers.FORCE_REDUCE_WINDOW_POOL = prev
+    g = jax.grad(
+        lambda a: jnp.sum(layers.max_pool(a, force_reduce_window=True))
+    )(x_all_tied)
+    expected = np.zeros((1, 2, 2, 1), np.float32)
+    expected[0, 0, 0, 0] = 1.0  # all gradient to the first argmax
+    np.testing.assert_allclose(np.asarray(g), expected)
+    # tie-free forward unchanged
+    rng = np.random.RandomState(0)
+    xc = jnp.asarray(rng.randn(1, 8, 8, 2).astype(np.float32))
+    np.testing.assert_allclose(
+        layers.max_pool(xc, force_reduce_window=True),
+        layers.max_pool(xc, force_reduce_window=False),
+        rtol=0, atol=0,
+    )
 
-    # config knob threads through to the module flag at system construction
+
+def test_pool_and_conv_conventions_are_per_model_not_global():
+    """The pooling convention and conv implementation are baked into each
+    built model (build_model parameters from Config.max_pool_reduce_window /
+    Config.conv_via_patches), NOT process globals: constructing a second
+    system with different conventions must not change the first model's
+    behavior, and MAMLSystem.__init__ must not touch the module defaults
+    (VERDICT r4 weak #5)."""
+    from howtotrainyourmamlpytorch_tpu.config import Config
     from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
 
-    try:
-        layers.FORCE_REDUCE_WINDOW_POOL = False  # an already-configured process
-        with pytest.warns(UserWarning, match="tie-subgradient"):
-            # flipping a configured value mid-process must warn (the flag is
-            # not in any compile-cache key — convention-change guard)
-            MAMLSystem(Config(max_pool_reduce_window=True))
-        assert layers.FORCE_REDUCE_WINDOW_POOL is True
-    finally:
-        layers.FORCE_REDUCE_WINDOW_POOL = prev
+    # flagship vgg expects Omniglot 28x28x1; constant input ties every
+    # interior pooling window, exposing the subgradient convention
+    x_all_tied = jnp.ones((1, 28, 28, 1), np.float32)
+
+    def tie_grad(model):
+        params, state = model.init(jax.random.PRNGKey(0))
+
+        def f(x):
+            logits, _ = model.apply(params, state, x, use_batch_stats=True)
+            return jnp.sum(logits**2)
+
+        return np.asarray(jax.grad(f)(x_all_tied))
+
+    cfg_kw = dict(
+        num_classes_per_set=2,
+        num_samples_per_class=1,
+        batch_size=1,
+        number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1,
+    )
+    sys_default = MAMLSystem(Config(**cfg_kw))
+    g_before = tie_grad(sys_default.model)
+
+    # a later system with the opposite conventions...
+    sys_forced = MAMLSystem(
+        Config(max_pool_reduce_window=True, conv_via_patches=True, **cfg_kw)
+    )
+    # ...does not change what the FIRST model computes (per-model baking;
+    # under the old global flags the forced conventions would leak into any
+    # program sys_default traces from now on)
+    np.testing.assert_allclose(tie_grad(sys_default.model), g_before, rtol=0, atol=0)
+    # while the forced system's own model really carries the torch
+    # first-argmax convention (gradient concentrated, not tie-split)
+    g_forced = tie_grad(sys_forced.model)
+    assert not np.allclose(g_forced, g_before)
+
+    # a caller-supplied model whose baked conventions contradict the config
+    # is rejected with a clear error (not a downstream GSPMD crash / silent
+    # wrong-convention run)
+    mismatched = build_model("vgg", (28, 28, 1), 2, conv_via_patches=False)
+    with pytest.raises(ValueError, match="conv_via_patches"):
+        MAMLSystem(Config(conv_via_patches=True, **cfg_kw), model=mismatched)

@@ -128,7 +128,8 @@ def test_tp_convs_sharded_meta_grads_match_single_device():
     )
     assert cfg.conv_via_patches  # auto-enabled by tp_convs
     model = build_vgg(
-        TINY_SHAPE, n_way, num_stages=2, cnn_num_filters=8, max_pooling=False
+        TINY_SHAPE, n_way, num_stages=2, cnn_num_filters=8, max_pooling=False,
+        conv_via_patches=True,
     )
     system = MAMLSystem(cfg, model=model)
     batch = _as_jnp(synthetic_batch(4, n_way, k, t, TINY_SHAPE, seed=7))
