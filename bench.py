@@ -200,8 +200,15 @@ class _Watchdog:
                      "error": f"report serialization failed: {e!r}"}
                 )
                 rc = 2
-        print(payload, flush=True)
-        os._exit(rc)
+            # print + exit INSIDE the lock (ADVICE r4): if this runs on the
+            # sigterm emitter thread while the main thread is entering
+            # emit_final, releasing the lock first would let emit_final see
+            # _done and return printless, main() exit, and interpreter
+            # shutdown kill this daemon thread before its print — zero JSON
+            # lines on stdout. Nothing else prints under the lock, and
+            # os._exit never returns, so holding it here is deadlock-free.
+            print(payload, flush=True)
+            os._exit(rc)
 
     def _watch(self) -> None:
         while True:

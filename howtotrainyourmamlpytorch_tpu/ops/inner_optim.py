@@ -111,7 +111,7 @@ def adam(lr: float = 0.1, beta1: float = 0.5, beta2: float = 0.5, eps: float = 1
             # meta-gradient, where inf * 0 = NaN then poisons the first
             # outer update (observed: every loss after iteration 0 NaN,
             # betas.csv all-NaN). Forward-identical to torch.optim.Adam at
-            # f32: sqrt(1e-24) = 1e-12, three orders below eps; backward
+            # f32: sqrt(1e-24) = 1e-12, four orders below eps (1e-8); backward
             # takes the (correct) zero subgradient of the clamp's flat
             # branch instead of inf.
             denom = jnp.sqrt(jnp.maximum(v, 1e-24)) / jnp.sqrt(bc2) + eps

@@ -63,11 +63,14 @@ def replicate(tree, mesh: Mesh):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
 
 
-def _param_spec(shape, mp: int, tp_convs: bool = False) -> P:
+def _param_spec(shape, mp: int, tp_convs: bool = False, leaf_name=None) -> P:
     """Tensor-parallel spec for one parameter leaf: *dense (2-D) kernels*
     shard their output-features axis (column-parallel ``P(None, 'mp')``)
-    when it divides ``mp``; with ``tp_convs`` HWIO conv kernels shard their
-    output-channel axis the same way; everything else is replicated.
+    when it divides ``mp``; with ``tp_convs`` HWIO conv kernels — 4-D
+    leaves named ``'w'``, the layer-zoo kernel convention (ADVICE r4: keyed
+    off the name so a future 4-D non-kernel parameter is not silently
+    mp-sharded) — shard their output-channel axis the same way; everything
+    else is replicated.
 
     Why exactly this layout (verified on the 8-device CPU mesh):
     - on the NATIVE conv path, conv-kernel channel sharding is rejected by
@@ -95,7 +98,13 @@ def _param_spec(shape, mp: int, tp_convs: bool = False) -> P:
     way (tests/test_parallel.py, __graft_entry__.dryrun_multichip)."""
     if len(shape) == 2 and shape[1] >= mp and shape[1] % mp == 0:
         return P(None, MODEL_AXIS)
-    if tp_convs and len(shape) == 4 and shape[3] >= mp and shape[3] % mp == 0:
+    if (
+        tp_convs
+        and leaf_name == "w"
+        and len(shape) == 4
+        and shape[3] >= mp
+        and shape[3] % mp == 0
+    ):
         return P(None, None, None, MODEL_AXIS)
     return P()
 
@@ -111,8 +120,11 @@ def train_state_shardings(state, mesh: Mesh, tp_convs: bool = False):
     if mp == 1:
         return jax.tree.map(lambda _: rep, state)
 
-    def param_sharding(leaf):
-        return NamedSharding(mesh, _param_spec(tuple(leaf.shape), mp, tp_convs))
+    def param_sharding(path, leaf):
+        leaf_name = getattr(path[-1], "key", None) if path else None
+        return NamedSharding(
+            mesh, _param_spec(tuple(leaf.shape), mp, tp_convs, leaf_name)
+        )
 
     def opt_spec(path, leaf):
         # the outer optimizer's moment trees (adam mu/nu) mirror the
@@ -120,10 +132,10 @@ def train_state_shardings(state, mesh: Mesh, tp_convs: bool = False):
         # mirrors exactly like the params; inner hparams are per-tensor
         # scalars — nothing to shard
         keys = {getattr(k, "key", None) for k in path}
-        return param_sharding(leaf) if "params" in keys else rep
+        return param_sharding(path, leaf) if "params" in keys else rep
 
     return type(state)(
-        params=jax.tree.map(param_sharding, state.params),
+        params=jax.tree_util.tree_map_with_path(param_sharding, state.params),
         bn_state=jax.tree.map(lambda _: rep, state.bn_state),
         inner_hparams=jax.tree.map(lambda _: rep, state.inner_hparams),
         opt_state=jax.tree_util.tree_map_with_path(opt_spec, state.opt_state),

@@ -18,6 +18,14 @@ cd /root/repo
 # $1 (optional) is a deadline in EPOCH SECONDS; earlier revisions took a pid
 # here, so reject anything not clearly in the future (a stale-style pid arg
 # would silently become a 1970 deadline and the sweep would start zero rows)
+case "${1:-}" in
+  *[!0-9]*)
+    # non-numeric arg: [ -le ] would error-and-continue and DEADLINE_EPOCH
+    # would export as garbage, silently disarming every later deadline
+    # comparison here and in sweep.sh (ADVICE r4) — reject it instead
+    echo "round4_queue.sh: deadline_epoch must be an integer epoch, got '$1'" >&2
+    exit 2;;
+esac
 if [ -n "${1:-}" ] && [ "$1" -le "$(date +%s)" ]; then
   echo "round4_queue.sh: deadline_epoch $1 is in the past" >&2
   exit 2
