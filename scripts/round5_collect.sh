@@ -1,0 +1,50 @@
+#!/bin/bash
+# Round-5 durable-artifact collector. No chip work: safe to run alongside the
+# serialized chip queue (scripts/round4_queue.sh) and its post-queue watcher.
+#
+# Why it exists: exps/ is gitignored and wiped on container resets, and the
+# queue script only copies run artifacts into results/ AFTER the whole sweep
+# returns — a reset mid-sweep would lose every completed row's logs (the
+# exact loss mode that cost round 3 its bench artifact). This loop snapshots
+# whatever exists every few minutes while the queue lives, then does a final
+# copy + regenerates the aggregated analysis.
+#
+# Usage: scripts/round5_collect.sh <queue_pid>
+set -u
+cd /root/repo
+QPID=${1:-}
+LOG=results/r5/collect.log
+mkdir -p results/r5
+
+snapshot () {
+  # bench captures under their round-5 names (the queue writes r04 names —
+  # it was authored in round 4; the content is the round-5 capture)
+  cp -f exps/bench_r04.json results/r5/bench_r05_capture.json 2>/dev/null
+  tail -c 4096 exps/bench_r04.err > results/r5/bench_r05_capture.err 2>/dev/null
+  cp -f exps/bench_r04_high.json results/r5/bench_r05_high.json 2>/dev/null
+  tail -c 2048 exps/bench_r04_high.err > results/r5/bench_r05_high.err 2>/dev/null
+  cp -f exps/round4_queue.log results/r5/queue.log 2>/dev/null
+  cp -f exps/sweep_r3.log results/r5/sweep.log 2>/dev/null
+  # per-row run artifacts (logs + learned hparams, never checkpoints)
+  for d in exps/omniglot.*; do
+    [ -d "$d/logs" ] || continue
+    name=$(basename "$d")
+    mkdir -p "results/r5/$name"
+    cp -f "$d"/logs/*.csv "$d"/logs/*.json "$d"/lrs.csv "$d"/betas.csv \
+      "$d"/config.yaml "results/r5/$name/" 2>/dev/null
+    tail -c 8192 "exps/${name}.out" > "results/r5/${name}.out.tail" 2>/dev/null
+  done
+}
+
+echo "=== $(date -u +%H:%M:%S) collector up (queue pid ${QPID:-none})" >> "$LOG"
+if [ -n "$QPID" ]; then
+  while kill -0 "$QPID" 2>/dev/null \
+      && grep -aq round4_queue "/proc/$QPID/cmdline" 2>/dev/null; do
+    snapshot
+    sleep 300
+  done
+fi
+snapshot
+echo "=== $(date -u +%H:%M:%S) queue gone; final snapshot + analysis" >> "$LOG"
+python analyze_results.py exps/ --out results/r5/analysis >> "$LOG" 2>&1
+echo "=== $(date -u +%H:%M:%S) collector done" >> "$LOG"
