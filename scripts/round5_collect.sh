@@ -6,8 +6,10 @@
 # queue script only copies run artifacts into results/ AFTER the whole sweep
 # returns — a reset mid-sweep would lose every completed row's logs (the
 # exact loss mode that cost round 3 its bench artifact). This loop snapshots
-# whatever exists every few minutes while the queue lives, then does a final
-# copy + regenerates the aggregated analysis.
+# whatever exists every few minutes while the queue lives (delegating per-row
+# copying to scripts/collect_run.sh, which takes the whole logs/ dir incl.
+# events.jsonl), then does a final copy + regenerates the aggregated
+# analysis.
 #
 # Usage: scripts/round5_collect.sh <queue_pid>
 set -u
@@ -16,23 +18,26 @@ QPID=${1:-}
 LOG=results/r5/collect.log
 mkdir -p results/r5
 
+copy_tail () {
+  # guarded: a bare `tail src > dst` truncates dst BEFORE tail fails on a
+  # missing src, zeroing previously captured artifacts after a container
+  # reset — the very loss mode this script defends against
+  [ -f "$1" ] && tail -c "$3" "$1" > "$2" 2>/dev/null
+}
+
 snapshot () {
   # bench captures under their round-5 names (the queue writes r04 names —
   # it was authored in round 4; the content is the round-5 capture)
   cp -f exps/bench_r04.json results/r5/bench_r05_capture.json 2>/dev/null
-  tail -c 4096 exps/bench_r04.err > results/r5/bench_r05_capture.err 2>/dev/null
+  copy_tail exps/bench_r04.err results/r5/bench_r05_capture.err 4096
   cp -f exps/bench_r04_high.json results/r5/bench_r05_high.json 2>/dev/null
-  tail -c 2048 exps/bench_r04_high.err > results/r5/bench_r05_high.err 2>/dev/null
+  copy_tail exps/bench_r04_high.err results/r5/bench_r05_high.err 2048
   cp -f exps/round4_queue.log results/r5/queue.log 2>/dev/null
   cp -f exps/sweep_r3.log results/r5/sweep.log 2>/dev/null
-  # per-row run artifacts (logs + learned hparams, never checkpoints)
+  # per-row run artifacts (full logs/ incl. events.jsonl; never checkpoints)
   for d in exps/omniglot.*; do
     [ -d "$d/logs" ] || continue
-    name=$(basename "$d")
-    mkdir -p "results/r5/$name"
-    cp -f "$d"/logs/*.csv "$d"/logs/*.json "$d"/lrs.csv "$d"/betas.csv \
-      "$d"/config.yaml "results/r5/$name/" 2>/dev/null
-    tail -c 8192 "exps/${name}.out" > "results/r5/${name}.out.tail" 2>/dev/null
+    bash scripts/collect_run.sh "$(basename "$d")" r5 >/dev/null 2>&1
   done
 }
 
@@ -46,5 +51,12 @@ if [ -n "$QPID" ]; then
 fi
 snapshot
 echo "=== $(date -u +%H:%M:%S) queue gone; final snapshot + analysis" >> "$LOG"
-python analyze_results.py exps/ --out results/r5/analysis >> "$LOG" 2>&1
+# analyze the volatile exps/ tree only while it actually has run dirs; after
+# a reset, fall back to the durable snapshots so a wiped exps/ can't
+# overwrite results/r5/analysis with an empty report
+if ls exps/omniglot.*/logs >/dev/null 2>&1; then
+  python analyze_results.py exps/ --out results/r5/analysis >> "$LOG" 2>&1
+else
+  python analyze_results.py results/r5 --out results/r5/analysis >> "$LOG" 2>&1
+fi
 echo "=== $(date -u +%H:%M:%S) collector done" >> "$LOG"

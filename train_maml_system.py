@@ -2,8 +2,11 @@
 """Training entry point (reference ``train_maml_system.py``).
 
 Usage:
-    python train_maml_system.py [--config configs/omniglot_20way_5shot.yaml] \
+    python train_maml_system.py [--config configs/omniglot_5way_1shot.yaml] \
         [key=value ...]
+
+(No --config runs the reference default, Omniglot 20-way 5-shot —
+``configs/default.yaml`` spells it out.)
 
 Overrides use dotted paths, e.g.::
 
