@@ -141,6 +141,52 @@ def collect_runs(exps_root: str) -> List[RunRecord]:
 # Aggregation (notebook cells 8-11)
 # ---------------------------------------------------------------------------
 
+# The reference's published test accuracies — mean ± std over 3 seeds, from
+# the committed outputs of its analysis notebook (BASELINE.md; reference
+# ``nbs/2019.09.14.plot.ipynb`` cell 11). Keyed like ``RunRecord.group_key``
+# (inner-optimizer kind in this framework's lowercase spelling) so every
+# aggregated row can carry its reference target and Δ automatically.
+REFERENCE_TEST_ACCURACY: Dict[Tuple[str, int, int, str, str], Tuple[float, float]] = {
+    ("mini_imagenet_full_size", 5, 1, "densenet-8", "sgd"): (46.08, 1.40),
+    ("mini_imagenet_full_size", 5, 1, "resnet-12", "sgd"): (51.06, 1.51),
+    ("mini_imagenet_full_size", 5, 1, "resnet-4", "adam"): (49.71, 3.71),
+    ("mini_imagenet_full_size", 5, 1, "resnet-4", "sgd"): (54.36, 0.23),
+    ("mini_imagenet_full_size", 5, 1, "resnet-8", "sgd"): (54.16, 1.35),
+    ("mini_imagenet_full_size", 5, 1, "vgg", "adam"): (47.93, 11.64),
+    ("mini_imagenet_full_size", 5, 1, "vgg", "sgd"): (56.33, 0.27),
+    ("mini_imagenet_full_size", 5, 5, "densenet-8", "sgd"): (65.29, 0.98),
+    ("mini_imagenet_full_size", 5, 5, "resnet-12", "adam"): (37.40, 3.64),
+    ("mini_imagenet_full_size", 5, 5, "resnet-12", "sgd"): (69.14, 3.19),
+    ("mini_imagenet_full_size", 5, 5, "resnet-4", "adam"): (76.33, 0.71),
+    ("mini_imagenet_full_size", 5, 5, "resnet-4", "sgd"): (74.48, 0.77),
+    ("mini_imagenet_full_size", 5, 5, "resnet-8", "adam"): (68.03, 15.19),
+    ("mini_imagenet_full_size", 5, 5, "resnet-8", "sgd"): (76.73, 0.52),
+    ("mini_imagenet_full_size", 5, 5, "vgg", "adam"): (72.82, 2.36),
+    ("mini_imagenet_full_size", 5, 5, "vgg", "sgd"): (75.13, 0.67),
+    ("omniglot_dataset", 5, 1, "densenet-8", "sgd"): (99.54, 0.33),
+    ("omniglot_dataset", 5, 1, "resnet-4", "sgd"): (99.91, 0.05),
+    ("omniglot_dataset", 5, 1, "vgg", "adam"): (99.62, 0.08),
+    ("omniglot_dataset", 5, 1, "vgg", "sgd"): (99.62, 0.08),
+    ("omniglot_dataset", 5, 5, "densenet-8", "sgd"): (99.86, 0.05),
+    ("omniglot_dataset", 5, 5, "resnet-4", "sgd"): (99.87, 0.03),
+    ("omniglot_dataset", 5, 5, "vgg", "adam"): (99.86, 0.04),
+    ("omniglot_dataset", 5, 5, "vgg", "sgd"): (99.86, 0.02),
+    ("omniglot_dataset", 20, 1, "densenet-8", "sgd"): (93.20, 0.32),
+    ("omniglot_dataset", 20, 1, "resnet-12", "sgd"): (99.00, 0.33),
+    ("omniglot_dataset", 20, 1, "resnet-4", "adam"): (98.31, 0.09),
+    ("omniglot_dataset", 20, 1, "resnet-4", "sgd"): (96.31, 0.15),
+    ("omniglot_dataset", 20, 1, "resnet-8", "sgd"): (98.50, 0.15),
+    ("omniglot_dataset", 20, 1, "vgg", "adam"): (96.15, 0.16),
+    ("omniglot_dataset", 20, 1, "vgg", "sgd"): (97.21, 0.11),
+    ("omniglot_dataset", 20, 5, "densenet-8", "sgd"): (97.24, 0.26),
+    ("omniglot_dataset", 20, 5, "resnet-12", "sgd"): (99.69, 0.17),
+    ("omniglot_dataset", 20, 5, "resnet-4", "adam"): (99.44, 0.23),
+    ("omniglot_dataset", 20, 5, "resnet-4", "sgd"): (99.71, 0.03),
+    ("omniglot_dataset", 20, 5, "resnet-8", "sgd"): (99.76, 0.01),
+    ("omniglot_dataset", 20, 5, "vgg", "adam"): (98.74, 0.04),
+    ("omniglot_dataset", 20, 5, "vgg", "sgd"): (99.13, 0.13),
+}
+
 
 @dataclasses.dataclass
 class AggregateRow:
@@ -152,12 +198,21 @@ class AggregateRow:
     mean: float  # test accuracy, percent
     std: float
     count: int  # seeds aggregated
+    # the reference's published number for the same ablation cell (None when
+    # the reference never ran it, e.g. any rprop cell)
+    ref_mean: Optional[float] = None
+    ref_std: Optional[float] = None
+
+    @property
+    def delta_vs_ref(self) -> Optional[float]:
+        return None if self.ref_mean is None else self.mean - self.ref_mean
 
 
 def aggregate_test_accuracy(
     runs: Sequence[RunRecord], min_seeds: int = 1
 ) -> List[AggregateRow]:
-    """Mean/std of meta-test accuracy over seeds per ablation cell.
+    """Mean/std of meta-test accuracy over seeds per ablation cell, each row
+    carrying the reference's published number for the same cell.
 
     The notebook keeps only cells where all 3 seeds finished (cell 8 filters
     ``count == 3``); ``min_seeds`` generalizes that threshold.
@@ -174,13 +229,24 @@ def aggregate_test_accuracy(
         accs = np.asarray(groups[key], np.float64)
         if len(accs) < min_seeds:
             continue
+        ref = REFERENCE_TEST_ACCURACY.get(key)
         rows.append(
-            AggregateRow(*key, mean=float(accs.mean()), std=float(accs.std()), count=len(accs))
+            AggregateRow(
+                *key,
+                mean=float(accs.mean()),
+                std=float(accs.std()),
+                count=len(accs),
+                ref_mean=ref[0] if ref else None,
+                ref_std=ref[1] if ref else None,
+            )
         )
     return rows
 
 
-_TABLE_HEADER = ["Dataset", "N-way", "K-shot", "Model", "Inner opt", "Test acc (%)", "Std", "Seeds"]
+_TABLE_HEADER = [
+    "Dataset", "N-way", "K-shot", "Model", "Inner opt",
+    "Test acc (%)", "Std", "Seeds", "Ref (3 seeds)", "Δ vs ref",
+]
 
 
 def to_markdown(rows: Sequence[AggregateRow]) -> str:
@@ -189,9 +255,15 @@ def to_markdown(rows: Sequence[AggregateRow]) -> str:
         "|" + "|".join("---" for _ in _TABLE_HEADER) + "|",
     ]
     for r in rows:
+        ref = (
+            f"{r.ref_mean:.2f} ± {r.ref_std:.2f}" if r.ref_mean is not None else "—"
+        )
+        delta = (
+            f"{r.delta_vs_ref:+.2f}" if r.delta_vs_ref is not None else "—"
+        )
         lines.append(
             f"| {r.dataset} | {r.n_way} | {r.k_shot} | {r.net} | {r.inner_optim} "
-            f"| {r.mean:.2f} | {r.std:.2f} | {r.count} |"
+            f"| {r.mean:.2f} | {r.std:.2f} | {r.count} | {ref} | {delta} |"
         )
     return "\n".join(lines) + "\n"
 
@@ -310,7 +382,11 @@ def write_report(exps_root: str, out_dir: str, min_seeds: int = 1) -> Dict[str, 
     with open(os.path.join(out_dir, "test_accuracy.tex"), "w") as f:
         f.write(to_latex(rows))
     with open(os.path.join(out_dir, "test_accuracy.json"), "w") as f:
-        json.dump([dataclasses.asdict(r) for r in rows], f, indent=1)
+        json.dump(
+            [{**dataclasses.asdict(r), "delta_vs_ref": r.delta_vs_ref} for r in rows],
+            f,
+            indent=1,
+        )
     plots = []
     for run in runs:
         # stem from the run dir's path relative to the sweep root, so

@@ -146,3 +146,23 @@ def test_results_archive_roundtrip(tmp_path):
     assert set(results_archive.list_archives(archive_dir)) == {path, path2}
     results_archive.delete_archive(path)
     assert results_archive.list_archives(archive_dir) == [path2]
+
+
+def test_aggregate_rows_carry_reference_baseline(tmp_path):
+    """Every aggregated cell the reference also published carries the
+    reference's mean/std (BASELINE.md / reference nbs cell 11) and a signed
+    delta; cells the reference never ran (any rprop config) carry None."""
+    root = str(tmp_path)
+    _make_run(root, "a.seed0", seed=0, test_acc=0.9862)  # vgg sgd 5w1s
+    _make_run(root, "c.seed0", seed=0, inner="rprop", test_acc=0.90)
+    rows = analysis.aggregate_test_accuracy(analysis.collect_runs(root))
+    by_opt = {r.inner_optim: r for r in rows}
+    vgg = by_opt["sgd"]
+    assert (vgg.ref_mean, vgg.ref_std) == (99.62, 0.08)
+    np.testing.assert_allclose(vgg.delta_vs_ref, 98.62 - 99.62)
+    assert by_opt["rprop"].ref_mean is None
+    assert by_opt["rprop"].delta_vs_ref is None
+    md = analysis.to_markdown(rows)
+    assert "99.62 ± 0.08" in md and "-1.00" in md
+    # rprop row renders the no-reference placeholder
+    assert "| — | — |" in md
