@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Serving-path benchmark: adapt latency, cached-predict latency, predict
+throughput through the full engine (bucketing + masking + jit).
+
+Prints ONE JSON line, same contract as ``bench.py``: ``{"metric", "value",
+"unit", "vs_baseline"}`` plus diagnostics. The headline is cached-predict
+throughput — the steady-state serving number once a client's support set is
+adapted and cached (the adapt-once / predict-many workload shape). There is
+no reference serving implementation to baseline against (the reference repo
+has no inference path at all), so ``vs_baseline`` is null.
+
+Runnable anywhere::
+
+    JAX_PLATFORMS=cpu python bench_serving.py            # CPU smoke numbers
+    python bench_serving.py --n-way 20 --k-shot 5        # flagship episode shape
+
+Model/episode defaults are the Omniglot 5-way 1-shot ablation shape with the
+full Conv-4 backbone; ``--tiny`` shrinks the model for CI smoke runs.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n-way", type=int, default=5)
+    parser.add_argument("--k-shot", type=int, default=1)
+    parser.add_argument("--n-query", type=int, default=15, help="query count per request")
+    parser.add_argument("--adapt-reps", type=int, default=8)
+    parser.add_argument("--predict-reps", type=int, default=32)
+    parser.add_argument("--batch", type=int, default=8, help="micro-batch size for throughput")
+    parser.add_argument("--tiny", action="store_true",
+                        help="2-stage 4-filter backbone (CI smoke)")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # Site hooks (e.g. a TPU-tunnel plugin) may override the platform
+        # selection after capturing the env; re-assert the user's choice.
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from howtotrainyourmamlpytorch_tpu.config import Config, ServingConfig
+    from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+    from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+    from howtotrainyourmamlpytorch_tpu.models import build_vgg
+    from howtotrainyourmamlpytorch_tpu.serving import AdaptationEngine
+
+    img = (28, 28, 1)
+    support = args.n_way * args.k_shot
+    cfg = Config(
+        num_classes_per_set=args.n_way,
+        num_samples_per_class=args.k_shot,
+        num_target_samples=max(args.n_query // args.n_way, 1),
+        serving=ServingConfig(
+            support_buckets=[support], query_buckets=[args.n_query],
+            max_batch_size=args.batch,
+        ),
+    )
+    stages, filters = (2, 4) if args.tiny else (4, 64)
+    system = MAMLSystem(
+        cfg, model=build_vgg(img, args.n_way, num_stages=stages, cnn_num_filters=filters)
+    )
+    engine = AdaptationEngine(system, system.init_train_state())
+
+    def episode(seed):
+        b = synthetic_batch(1, args.n_way, args.k_shot, cfg.num_target_samples, img, seed)
+        return (
+            b["x_support"][0],
+            b["y_support"][0],
+            b["x_target"][0].reshape((-1,) + img)[: args.n_query],
+        )
+
+    # --- warm up the compiled programs (excluded from every measurement) ---
+    x_s, y_s, x_q = episode(0)
+    fw = engine.adapt(x_s, y_s)
+    engine.predict(fw, x_q)
+    engine.adapt_batch([episode(i)[:2] for i in range(args.batch)])
+    engine.predict_batch([(fw, x_q)] * args.batch)
+
+    # --- adapt latency (uncached: a fresh support set every rep) ---
+    adapt_ms = []
+    weights = []
+    for i in range(args.adapt_reps):
+        x_s, y_s, _ = episode(100 + i)
+        t0 = time.perf_counter()
+        w = engine.adapt(x_s, y_s)
+        jax.block_until_ready(w)
+        adapt_ms.append((time.perf_counter() - t0) * 1e3)
+        weights.append(w)
+
+    # --- cached-predict latency (weights already adapted: predict only) ---
+    predict_ms = []
+    for i in range(args.predict_reps):
+        _, _, x_q = episode(200 + i)
+        t0 = time.perf_counter()
+        engine.predict(weights[i % len(weights)], x_q)
+        predict_ms.append((time.perf_counter() - t0) * 1e3)
+
+    # --- predict throughput at the micro-batch size ---
+    items = [(weights[i % len(weights)], episode(300 + i)[2]) for i in range(args.batch)]
+    reps = max(args.predict_reps // args.batch, 1)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        engine.predict_batch(items)
+    elapsed = time.perf_counter() - t0
+    queries_per_sec = reps * args.batch * args.n_query / elapsed
+
+    result = {
+        "metric": f"serving_cached_predict_queries_per_sec_{args.n_way}w{args.k_shot}s_b{args.batch}",
+        "value": round(queries_per_sec, 2),
+        "unit": "queries/sec",
+        "vs_baseline": None,  # reference has no serving path to compare against
+        "platform": jax.default_backend(),
+        "adapt_p50_ms": round(float(np.percentile(adapt_ms, 50)), 3),
+        "adapt_p95_ms": round(float(np.percentile(adapt_ms, 95)), 3),
+        "cached_predict_p50_ms": round(float(np.percentile(predict_ms, 50)), 3),
+        "cached_predict_p95_ms": round(float(np.percentile(predict_ms, 95)), 3),
+        "n_way": args.n_way,
+        "k_shot": args.k_shot,
+        "n_query": args.n_query,
+        "micro_batch": args.batch,
+        "model": f"vgg{stages}x{filters}",
+        "compiled": engine.compile_counts(),
+    }
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,6 +86,56 @@ class ParallelConfig:
 
 
 @dataclass
+class ServingConfig:
+    """Adapt-as-a-service engine knobs (serving/ package) — no reference
+    equivalent (the reference has no inference path at all). The workload
+    shape is adapt-once / predict-many: a client uploads a small support set,
+    the server runs the inner loop once, then answers query requests against
+    the cached adapted weights."""
+
+    # Compiled shape buckets for the flattened support size (n_way * k_shot)
+    # and the flattened query count: requests are padded up to the smallest
+    # bucket >= their actual size so novel request shapes reuse an existing
+    # compiled program instead of triggering an XLA recompile. Padded samples
+    # are masked out of the loss and the transductive-BN statistics, so
+    # bucketing never changes predictions. A request larger than the largest
+    # bucket compiles its exact shape on demand.
+    support_buckets: List[int] = field(default_factory=lambda: [25, 50, 100, 200])
+    query_buckets: List[int] = field(default_factory=lambda: [5, 15, 40, 100])
+    # Micro-batching: concurrent same-bucket requests are stacked along the
+    # task axis (the axis MAMLSystem vmaps over) and flushed as ONE device
+    # dispatch when max_batch_size requests are queued or the oldest request
+    # has waited batch_deadline_ms. The task axis is padded up to the nearest
+    # power of two <= max_batch_size so batch sizes also reuse compiles.
+    max_batch_size: int = 8
+    batch_deadline_ms: float = 3.0
+    # Adapted-weight cache: content-addressed by (checkpoint fingerprint,
+    # support-set digest); repeat clients skip the inner loop entirely.
+    cache_max_bytes: int = 256 * 1024 * 1024
+    cache_ttl_s: float = 600.0
+    # Inner steps per adapt request; 0 = the config's eval horizon
+    # (number_of_evaluation_steps_per_iter), matching eval_step exactly.
+    adapt_steps: int = 0
+    # HTTP front-end (scripts/serve.py)
+    host: str = "127.0.0.1"
+    port: int = 8100
+    # per-phase latency ring-buffer length for the /metrics percentiles
+    latency_window: int = 2048
+
+    def __post_init__(self):
+        self.support_buckets = sorted(int(b) for b in self.support_buckets)
+        self.query_buckets = sorted(int(b) for b in self.query_buckets)
+        if any(b <= 0 for b in self.support_buckets + self.query_buckets):
+            raise ValueError("serving buckets must be positive")
+        if self.max_batch_size < 1:
+            raise ValueError(f"max_batch_size must be >= 1, got {self.max_batch_size}")
+        if self.batch_deadline_ms < 0:
+            raise ValueError("batch_deadline_ms must be >= 0")
+        if self.latency_window < 1:
+            raise ValueError(f"latency_window must be >= 1, got {self.latency_window}")
+
+
+@dataclass
 class Config:
     # --- data provider (reference config.yaml:11-20,63-65) ---
     num_dataprovider_workers: int = 4
@@ -218,6 +268,8 @@ class Config:
 
     # --- TPU-native knobs (no reference equivalent) ---
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    # --- few-shot serving engine (serving/ package; no reference equivalent) ---
+    serving: ServingConfig = field(default_factory=ServingConfig)
     compute_dtype: str = "float32"  # or "bfloat16" for MXU-friendly compute
     remat_inner_steps: bool = True  # jax.checkpoint per inner step (SURVEY §5.7)
     # Fully unroll the inner-step lax.scan: removes scan sequencing overhead
@@ -376,8 +428,8 @@ def _dataclass_from_dict(cls, data: Dict[str, Any]):
         if name not in data:
             continue
         value = data[name]
-        if name in ("dataset", "inner_optim", "parallel"):
-            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig}[name]
+        if name in ("dataset", "inner_optim", "parallel", "serving"):
+            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig, "serving": ServingConfig}[name]
             presets = {"dataset": DATASET_PRESETS, "inner_optim": INNER_OPTIM_PRESETS}.get(name, {})
             if isinstance(value, str):
                 if value not in presets:

@@ -258,6 +258,92 @@ class MAMLSystem:
             "exp_avg_sq": adam_state.nu["params"],
         }
 
+    def _apply_forward(self, params, bn_state, x, sample_weight=None):
+        """One model forward in the compute dtype, f32 logits out.
+
+        ``sample_weight`` ([N], 1 = real / 0 = padding) is forwarded to the
+        model so transductive-BN statistics ignore padded samples — only the
+        serving engine's shape-bucketed programs pass it; training/eval
+        batches are never padded, and None keeps the apply call (and any
+        hand-built Model without the kwarg) exactly as before."""
+        cdt = self.compute_dtype
+        if cdt != jnp.float32:
+            params = jax.tree.map(lambda a: a.astype(cdt), params)
+            x = x.astype(cdt)
+        kwargs = {} if sample_weight is None else {"sample_weight": sample_weight}
+        logits, _ = self.model.apply(
+            params, bn_state, x, use_batch_stats=True, **kwargs
+        )
+        return logits.astype(jnp.float32)
+
+    def _make_inner_update(
+        self, bn_state, x_support, y_support, second_order, support_weight=None
+    ):
+        """Build ``inner_update(p, opt_state, hp) -> (p', opt_state')`` — one
+        support-set gradient step, shared by the meta-objective rollout and
+        the serving adapt path."""
+
+        def inner_update(p, opt_s, hp):
+            def support_loss_fn(q):
+                return cross_entropy(
+                    self._apply_forward(q, bn_state, x_support, support_weight),
+                    y_support,
+                    sample_weight=support_weight,
+                )
+
+            grads = jax.grad(support_loss_fn)(p)
+            if not second_order:
+                grads = jax.tree.map(lax.stop_gradient, grads)
+            return self.inner_opt.update(grads, opt_s, p, hp)
+
+        return inner_update
+
+    def _hparam_sequence(self, hparams, num_steps: int):
+        """Per-step hparam sequence scanned as xs. Fork semantics (default):
+        the same hparams every step (free broadcast). Upstream-LSLR mode
+        (lslr_per_step): slice the leading step axis; eval horizons beyond
+        the trained one reuse the last step's values."""
+        if self._per_step_hparams:
+            K = self.cfg.number_of_training_steps_per_iter
+            idx = jnp.minimum(jnp.arange(num_steps), K - 1)
+            return jax.tree.map(lambda a: a[idx], hparams)
+        return jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (num_steps,) + jnp.shape(a)), hparams
+        )
+
+    def _adapt_loop(
+        self,
+        params,
+        bn_state,
+        hparams,
+        inner_state,
+        x_support,
+        y_support,
+        second_order: bool,
+        num_steps: int,
+        support_weight=None,
+    ):
+        """The inner-loop rollout alone: ``num_steps`` support-set updates ->
+        final fast weights. Factored out of the meta-objective so the serving
+        engine (serving/engine.py) can run adaptation as a standalone program
+        — first-order, no target forward, no meta-gradient graph."""
+        inner_update = self._make_inner_update(
+            bn_state, x_support, y_support, second_order, support_weight
+        )
+        hp_seq = self._hparam_sequence(hparams, num_steps)
+        unroll = num_steps if self.cfg.unroll_inner_steps else 1
+
+        def step(carry, hp):
+            p, opt_s = carry
+            return inner_update(p, opt_s, hp), None
+
+        if self.cfg.remat_inner_steps:
+            step = jax.checkpoint(step, prevent_cse=False)
+        (p_final, _), _ = lax.scan(
+            step, (params, inner_state), hp_seq, unroll=unroll
+        )
+        return p_final
+
     def _rollout(
         self,
         params,
@@ -280,41 +366,14 @@ class MAMLSystem:
         the reference's post-annealing/eval path
         (few_shot_learning_system.py:246-251). Returns
         (task_loss, final_target_logits)."""
-        cdt = self.compute_dtype
-        model = self.model
-
-        def forward(p, x):
-            if cdt != jnp.float32:
-                p = jax.tree.map(lambda a: a.astype(cdt), p)
-                x = x.astype(cdt)
-            logits, _ = model.apply(p, bn_state, x, use_batch_stats=True)
-            return logits.astype(jnp.float32)
-
-        def inner_update(p, opt_s, hp):
-            def support_loss_fn(q):
-                return cross_entropy(forward(q, x_support), y_support)
-
-            grads = jax.grad(support_loss_fn)(p)
-            if not second_order:
-                grads = jax.tree.map(lax.stop_gradient, grads)
-            return self.inner_opt.update(grads, opt_s, p, hp)
-
-        # Per-step hparam sequence scanned as xs. Fork semantics (default):
-        # the same hparams every step (free broadcast). Upstream-LSLR mode
-        # (lslr_per_step): slice the leading step axis; eval horizons beyond
-        # the trained one reuse the last step's values.
-        if self._per_step_hparams:
-            K = self.cfg.number_of_training_steps_per_iter
-            idx = jnp.minimum(jnp.arange(num_steps), K - 1)
-            hp_seq = jax.tree.map(lambda a: a[idx], hparams)
-        else:
-            hp_seq = jax.tree.map(
-                lambda a: jnp.broadcast_to(a, (num_steps,) + jnp.shape(a)), hparams
-            )
-
-        unroll = num_steps if self.cfg.unroll_inner_steps else 1
+        forward = lambda p, x: self._apply_forward(p, bn_state, x)
 
         if per_step_target:
+            inner_update = self._make_inner_update(
+                bn_state, x_support, y_support, second_order
+            )
+            hp_seq = self._hparam_sequence(hparams, num_steps)
+            unroll = num_steps if self.cfg.unroll_inner_steps else 1
 
             def step(carry, xs):
                 weight, hp = xs
@@ -332,14 +391,9 @@ class MAMLSystem:
             )
             return jnp.sum(weighted_losses), final_logits
 
-        def step(carry, hp):
-            p, opt_s = carry
-            return inner_update(p, opt_s, hp), None
-
-        if self.cfg.remat_inner_steps:
-            step = jax.checkpoint(step, prevent_cse=False)
-        (p_final, _), _ = lax.scan(
-            step, (params, inner_state), hp_seq, unroll=unroll
+        p_final = self._adapt_loop(
+            params, bn_state, hparams, inner_state, x_support, y_support,
+            second_order, num_steps,
         )
         final_logits = forward(p_final, x_target)
         return cross_entropy(final_logits, y_target), final_logits
@@ -526,6 +580,53 @@ class MAMLSystem:
 
     def eval_step(self, state: TrainState, batch) -> StepOutput:
         return self._eval_step(state, batch)
+
+    # ------------------------------------------------------------------
+    # serving entry points (adapt-once / predict-many; serving/engine.py)
+    # ------------------------------------------------------------------
+
+    def adapt_fast_weights(
+        self,
+        state: TrainState,
+        x_support,
+        y_support,
+        num_steps: Optional[int] = None,
+        support_weight=None,
+    ):
+        """Inner-loop adaptation only: support set [S, H, W, C] / [S] ->
+        adapted parameter pytree. First-order (no meta-gradient graph is ever
+        built — nothing differentiates through this), no target forward; the
+        same rollout ``eval_step`` runs per task, so
+        ``predict_logits(adapt_fast_weights(...), ...)`` reproduces the
+        eval-step target logits. ``support_weight`` masks padded samples out
+        of the loss and the transductive-BN statistics (shape bucketing).
+        Deliberately not jitted here — the serving engine jits per shape
+        bucket and task-batch size."""
+        cfg = self.cfg
+        if num_steps is None:
+            num_steps = cfg.number_of_evaluation_steps_per_iter
+        hparams = self._inner_hparams_for_rollout(state.inner_hparams, state.params)
+        inner_state = self._initial_inner_state(
+            state.params, hparams, state.opt_state
+        )
+        return self._adapt_loop(
+            state.params,
+            state.bn_state,
+            hparams,
+            inner_state,
+            x_support,
+            y_support,
+            second_order=False,
+            num_steps=num_steps,
+            support_weight=support_weight,
+        )
+
+    def predict_logits(self, fast_weights, bn_state, x, sample_weight=None):
+        """Forward a query batch [Q, H, W, C] through adapted fast weights ->
+        f32 logits [Q, num_classes]. Transductive BN over the query batch
+        (the reference's eval convention); ``sample_weight`` masks padded
+        queries out of the statistics."""
+        return self._apply_forward(fast_weights, bn_state, x, sample_weight)
 
     # ------------------------------------------------------------------
     # multi-step dispatch

@@ -34,6 +34,12 @@ def _stack(episodes) -> Dict[str, np.ndarray]:
     return {k: np.stack([e[k] for e in episodes]) for k in episodes[0]}
 
 
+def _shutdown_pools(*pools) -> None:
+    """weakref.finalize target — must not capture the loader itself."""
+    for pool in pools:
+        pool.shutdown(wait=False)
+
+
 class MetaLearningDataLoader:
     def __init__(
         self,
@@ -67,18 +73,41 @@ class MetaLearningDataLoader:
         # episode work is a cheap numpy gather, pool churn would dominate it.
         # Sized for both in-flight prefetch builds (window=2) so overlapping
         # builds don't halve per-build parallelism.
-        self._episode_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=self.num_workers * self._PREFETCH_WINDOW
-        )
-        self._finalizer = weakref.finalize(
-            self, self._episode_pool.shutdown, wait=False
-        )
+        self._episode_pool = None
+        self._window_pool = None
+        self._finalizer = None
+        self._ensure_pools()
 
     _PREFETCH_WINDOW = 2  # batches in flight ahead of the consumer
 
+    def _ensure_pools(self) -> None:
+        """(Re)create the worker pools. The episode pool assembles episodes
+        within a batch; the prefetch-window pool drives whole-batch builds
+        ahead of the consumer — persistent per loader, NOT per iterator
+        (previously ``_prefetched`` spun up and tore down a fresh executor
+        per iterator, once per epoch per split — thousands of churned
+        threads over a run for a pool whose lifetime should be the
+        loader's). A closed loader reopens on next use, so runners can
+        release threads at run end while callers may still evaluate later."""
+        if self._episode_pool is not None:
+            return
+        self._episode_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.num_workers * self._PREFETCH_WINDOW
+        )
+        self._window_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self._PREFETCH_WINDOW
+        )
+        self._finalizer = weakref.finalize(
+            self, _shutdown_pools, self._episode_pool, self._window_pool
+        )
+
     def close(self) -> None:
-        """Shut down the episode-assembly pool (also runs via GC finalizer)."""
-        self._finalizer()
+        """Shut down the worker pools (also runs via GC finalizer). Not
+        terminal: the next batch request transparently reopens them."""
+        if self._finalizer is not None:
+            self._finalizer()
+        self._episode_pool = None
+        self._window_pool = None
 
     def continue_from_iter(self, current_iter: int) -> None:
         self.train_episodes_produced = current_iter * self.batch_size
@@ -109,17 +138,20 @@ class MetaLearningDataLoader:
     def _prefetched(self, build, total: int, advance_per_yield: int) -> Iterator:
         """Drive ``build(i)`` for i in [0, total) through the bounded
         prefetch window, advancing the train cursor by ``advance_per_yield``
-        episodes as each item is handed to the consumer."""
+        episodes as each item is handed to the consumer. Uses the loader's
+        persistent window pool; an abandoned iterator leaves at most
+        ``_PREFETCH_WINDOW`` in-flight builds to finish idle."""
         window = self._PREFETCH_WINDOW
-        with concurrent.futures.ThreadPoolExecutor(max_workers=window) as ahead:
-            futures = {i: ahead.submit(build, i) for i in range(min(window, total))}
-            for i in range(total):
-                item = futures.pop(i).result()
-                nxt = i + window
-                if nxt < total:
-                    futures[nxt] = ahead.submit(build, nxt)
-                self.train_episodes_produced += advance_per_yield
-                yield item
+        self._ensure_pools()
+        ahead = self._window_pool
+        futures = {i: ahead.submit(build, i) for i in range(min(window, total))}
+        for i in range(total):
+            item = futures.pop(i).result()
+            nxt = i + window
+            if nxt < total:
+                futures[nxt] = ahead.submit(build, nxt)
+            self.train_episodes_produced += advance_per_yield
+            yield item
 
     def _batches(
         self,

@@ -11,9 +11,10 @@ File naming mirrors the reference ("{name}_{idx}" with idx = epoch or
 'latest'); ``max_models_to_save`` rotation matches ``config.yaml:12``.
 """
 
+import hashlib
 import os
 import re
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -22,6 +23,22 @@ from flax import serialization
 from ..core.train_state import TrainState
 
 MODEL_NAME = "train_model"
+
+
+class InferenceState(NamedTuple):
+    """The checkpoint subset a serving process needs: meta-parameters, BN
+    state, learned inner-opt hyperparams, and the step counter — WITHOUT the
+    outer optimizer moments (for the flagship config the optimizer state is
+    ~2/3 of the checkpoint, and a server never takes an outer step).
+    ``fingerprint`` is a content hash of the checkpoint file, the cache-key
+    component that invalidates adapted-weight cache entries across model
+    pushes (serving/cache.py)."""
+
+    params: Any
+    bn_state: Any
+    inner_hparams: Any
+    step: Any
+    fingerprint: str
 
 
 def _path(save_dir: str, idx) -> str:
@@ -95,6 +112,33 @@ def load_checkpoint(
     template = jax.tree.map(np.asarray, template_state)
     state = serialization.from_bytes(template, payload["network"])
     return TrainState(*state), payload["bookkeeping"]
+
+
+def load_for_inference(save_dir: str, idx) -> Tuple[InferenceState, Dict[str, Any]]:
+    """Restore params / BN state / inner hyperparams / step for serving,
+    dropping the outer optimizer state (serving never takes an outer step;
+    note this also means an inner-Adam config with
+    ``warm_start_inner_opt_from_outer`` adapts from cold inner moments when
+    loaded this way — the warm start is a training-time coupling to the
+    outer Adam that a standalone server deliberately does not carry).
+
+    Unlike :func:`load_checkpoint` this needs no template state: the flax
+    msgpack payload stores the TrainState by field name with plain
+    dict-of-ndarray subtrees, which restore structurally as-is."""
+    with open(_path(save_dir, idx), "rb") as f:
+        blob = f.read()
+    payload = serialization.msgpack_restore(blob)
+    # "network" is itself msgpack bytes (see _serialize): decode the inner
+    # layer to the field-name-keyed TrainState dict
+    net = serialization.msgpack_restore(payload["network"])
+    state = InferenceState(
+        params=net["params"],
+        bn_state=net["bn_state"],
+        inner_hparams=net["inner_hparams"],
+        step=np.asarray(net["step"]),
+        fingerprint=hashlib.sha256(blob).hexdigest(),
+    )
+    return state, payload["bookkeeping"]
 
 
 def latest_checkpoint_exists(save_dir: str) -> bool:

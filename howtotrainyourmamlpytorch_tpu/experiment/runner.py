@@ -164,6 +164,10 @@ class ExperimentRunner:
         host_shard = (
             (jax.process_index(), jax.process_count()) if self._multihost else None
         )
+        # the runner shuts an owned loader down when run_experiment exits; a
+        # caller-supplied loader (shared across runners in a sweep) is the
+        # caller's to close
+        self._owns_loader = loader is None
         self.loader = loader or MetaLearningDataLoader(
             cfg,
             current_iter=self.start_epoch * cfg.total_iter_per_epoch,
@@ -427,6 +431,17 @@ class ExperimentRunner:
         return stats
 
     def run_experiment(self) -> Dict[str, Any]:
+        """Train/eval to completion. An owned loader is shut down on EVERY
+        exit path — normal completion, the SystemExit(3) early-divergence
+        abort, and errors — so back-to-back runs in one process (sweeps,
+        tests) don't accumulate leaked episode-pool threads."""
+        try:
+            return self._run_experiment()
+        finally:
+            if self._owns_loader:
+                self.loader.close()
+
+    def _run_experiment(self) -> Dict[str, Any]:
         cfg = self.cfg
         if cfg.evaluate_on_test_set_only:
             self.load_best()

@@ -35,11 +35,18 @@ def _init_dense_layer(key, cin, growth_rate, bn_size):
     return params, state
 
 
-def _apply_dense_layer(params, state, x, use_batch_stats, update_running, via_patches=False):
-    out, n1_s = layers.batch_norm(params["norm1"], state["norm1"], x, use_batch_stats, update_running)
+def _apply_dense_layer(params, state, x, use_batch_stats, update_running, via_patches=False,
+                       sample_weight=None):
+    out, n1_s = layers.batch_norm(
+        params["norm1"], state["norm1"], x, use_batch_stats, update_running,
+        sample_weight=sample_weight,
+    )
     out = layers.relu(out)
     out = layers.conv2d(params["conv1"], out, stride=1, padding=0, via_patches=via_patches)
-    out, n2_s = layers.batch_norm(params["norm2"], state["norm2"], out, use_batch_stats, update_running)
+    out, n2_s = layers.batch_norm(
+        params["norm2"], state["norm2"], out, use_batch_stats, update_running,
+        sample_weight=sample_weight,
+    )
     out = layers.relu(out)
     out = layers.conv2d(params["conv2"], out, stride=1, padding=1, via_patches=via_patches)
     return out, {"norm1": n1_s, "norm2": n2_s}
@@ -96,7 +103,8 @@ def build_densenet(
         )
         return params, state
 
-    def apply(params, state, x, *, use_batch_stats=True, update_running=False):
+    def apply(params, state, x, *, use_batch_stats=True, update_running=False,
+              sample_weight=None):
         new_state = {}
         for i, num_layers in enumerate(block_config):
             bname = f"denseblock{i + 1}"
@@ -106,6 +114,7 @@ def build_densenet(
                 new_feat, ls = _apply_dense_layer(
                     params[bname][lname], state[bname][lname], x,
                     use_batch_stats, update_running, conv_via_patches,
+                    sample_weight,
                 )
                 block_s[lname] = ls
                 x = jnp.concatenate([x, new_feat], axis=-1)
@@ -114,7 +123,7 @@ def build_densenet(
                 tname = f"transition{i + 1}"
                 x, tn_s = layers.batch_norm(
                     params[tname]["norm"], state[tname]["norm"], x,
-                    use_batch_stats, update_running,
+                    use_batch_stats, update_running, sample_weight=sample_weight,
                 )
                 x = layers.relu(x)
                 x = layers.conv2d(
@@ -123,7 +132,10 @@ def build_densenet(
                 )
                 x = layers.avg_pool(x)
                 new_state[tname] = {"norm": tn_s}
-        x, n5_s = layers.batch_norm(params["norm5"], state["norm5"], x, use_batch_stats, update_running)
+        x, n5_s = layers.batch_norm(
+            params["norm5"], state["norm5"], x, use_batch_stats, update_running,
+            sample_weight=sample_weight,
+        )
         new_state["norm5"] = n5_s
         x = layers.relu(x)
         x = layers.global_avg_pool(x)

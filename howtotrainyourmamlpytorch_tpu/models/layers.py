@@ -207,6 +207,7 @@ def batch_norm(
     update_running: bool = False,
     momentum: float = 0.1,
     eps: float = 1e-5,
+    sample_weight=None,
 ):
     """Functional batch-norm over NHWC (reduce N,H,W) or NC input (reduce N).
 
@@ -218,11 +219,25 @@ def batch_norm(
     copies and the meta-model's buffers are never updated. They exist for API
     completeness (``update_running=True`` + ``use_batch_stats=False`` gives
     conventional BN for non-transductive experiments).
+
+    ``sample_weight`` ([N], 1.0 = real, 0.0 = padding) computes the batch
+    statistics over real samples only, so a batch padded up to a compiled
+    shape bucket (serving/engine.py) normalizes exactly as the unpadded
+    batch would — the enabler for transductive BN under shape bucketing.
+    None keeps the unweighted reduction bit-for-bit identical to before.
     """
     axes = tuple(range(x.ndim - 1))
     if use_batch_stats:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        if sample_weight is None:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+        else:
+            w = sample_weight.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+            # per-channel element count: real samples x spatial positions
+            spatial = x.size // (x.shape[0] * x.shape[-1])
+            denom = jnp.maximum(jnp.sum(sample_weight) * spatial, 1.0)
+            mean = jnp.sum(w * x, axis=axes) / denom
+            var = jnp.sum(w * jnp.square(x - mean), axis=axes) / denom
     else:
         mean, var = state["mean"], state["var"]
     inv = lax.rsqrt(var + eps)

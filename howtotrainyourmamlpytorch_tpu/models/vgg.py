@@ -37,7 +37,7 @@ def build_vgg(
     conv_stride = 1 if max_pooling else 2
     pad = 1 if conv_padding else 0
 
-    def stem(params, state, x, use_batch_stats, update_running):
+    def stem(params, state, x, use_batch_stats, update_running, sample_weight=None):
         new_state = {}
         for i in range(num_stages):
             name = f"stage_{i}"
@@ -47,7 +47,8 @@ def build_vgg(
                 via_patches=conv_via_patches,
             )
             x, bn_state = layers.batch_norm(
-                p["bn"], state[name]["bn"], x, use_batch_stats, update_running
+                p["bn"], state[name]["bn"], x, use_batch_stats, update_running,
+                sample_weight=sample_weight,
             )
             new_state[name] = {"bn": bn_state}
             x = layers.leaky_relu(x)
@@ -68,7 +69,7 @@ def build_vgg(
             state[f"stage_{i}"] = {"bn": bn_s}
             cin = cnn_num_filters
         feat_shape = jax.eval_shape(
-            lambda p, s: stem(p, s, jnp.zeros((1, h, w, c)), True, False)[0],
+            lambda p, s: stem(p, s, jnp.zeros((1, h, w, c)), True, False, None)[0],
             params,
             state,
         ).shape
@@ -76,8 +77,11 @@ def build_vgg(
         params["fc"] = layers.init_linear(keys[-1], flat, num_classes)
         return params, state
 
-    def apply(params, state, x, *, use_batch_stats=True, update_running=False):
-        x, new_state = stem(params, state, x, use_batch_stats, update_running)
+    def apply(params, state, x, *, use_batch_stats=True, update_running=False,
+              sample_weight=None):
+        x, new_state = stem(
+            params, state, x, use_batch_stats, update_running, sample_weight
+        )
         x = layers.flatten(x)
         return layers.linear(params["fc"], x), new_state
 

@@ -4,12 +4,19 @@ import jax
 import jax.numpy as jnp
 
 
-def cross_entropy(logits, labels):
+def cross_entropy(logits, labels, sample_weight=None):
     """Mean softmax cross-entropy with integer labels (= F.cross_entropy,
-    reference ``few_shot_learning_system.py:223-224``)."""
+    reference ``few_shot_learning_system.py:223-224``).
+
+    ``sample_weight`` ([N], 1.0 = real, 0.0 = padding) averages over real
+    samples only — sum(w * nll) / sum(w) — so a batch padded up to a compiled
+    shape bucket (serving/engine.py) yields the exact unpadded loss and
+    gradients. None keeps the unweighted mean bit-identical to before."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
-    return jnp.mean(nll)
+    if sample_weight is None:
+        return jnp.mean(nll)
+    return jnp.sum(sample_weight * nll) / jnp.maximum(jnp.sum(sample_weight), 1.0)
 
 
 def accuracy(logits, labels):

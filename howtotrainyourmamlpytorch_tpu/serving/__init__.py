@@ -1,0 +1,31 @@
+"""Few-shot adaptation serving: a trained checkpoint as a request engine.
+
+MAML's value at inference time is cheap per-client adaptation (Finn et al.;
+PAPER.md): a client uploads a small support set, the server runs the inner
+loop once, then answers many query requests against the adapted weights —
+adapt-once / predict-many. This package turns a saved checkpoint into that
+server:
+
+- :mod:`engine` — ``AdaptationEngine``: separately-jitted ``adapt`` /
+  ``predict`` entry points with shape bucketing (padded + masked, so novel
+  request shapes don't recompile and padding never changes predictions);
+- :mod:`cache` — ``AdaptedWeightCache``: content-addressed LRU of adapted
+  parameter trees (byte budget, TTL, hit/miss/eviction counters);
+- :mod:`batcher` — ``MicroBatcher``: deadline/max-batch micro-batching of
+  concurrent requests into single device dispatches;
+- :mod:`metrics` — ``LatencyStats``: per-phase p50/p95/p99;
+- :mod:`server` — ``ServingFrontend`` (in-process API) + a stdlib
+  ``ThreadingHTTPServer`` JSON front-end (``scripts/serve.py``).
+"""
+
+from .batcher import MicroBatcher  # noqa: F401
+from .cache import AdaptedWeightCache, support_digest, tree_bytes  # noqa: F401
+from .engine import AdaptationEngine  # noqa: F401
+from .metrics import LatencyStats  # noqa: F401
+from .server import (  # noqa: F401
+    ServingFrontend,
+    UnknownAdaptationError,
+    frontend_from_run_dir,
+    make_http_server,
+    serve_forever,
+)

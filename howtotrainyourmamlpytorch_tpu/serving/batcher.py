@@ -1,0 +1,161 @@
+"""Micro-batching scheduler for concurrent adapt / predict requests.
+
+Concurrent requests whose tensors pad to the same shape bucket are stacked
+along the task axis — the axis ``MAMLSystem`` already vmaps over — and
+dispatched to the device as ONE compiled call. A group flushes when it
+reaches ``max_batch`` requests or when its oldest request has waited
+``deadline_ms`` (a few ms: long enough to coalesce a concurrent burst, short
+enough to be invisible next to an inner-loop rollout). One worker thread owns
+all flushes, so device dispatch is serialized — no jit-cache races, no
+interleaved transfers.
+"""
+
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Hashable, List, Tuple
+
+
+class MicroBatcher:
+    """Groups submitted payloads by bucket key and flushes each group through
+    ``flush_fn(bucket_key, payloads) -> results`` (one result per payload, in
+    order). ``submit`` returns a ``Future``; a ``flush_fn`` exception fails
+    every future of its group."""
+
+    def __init__(
+        self,
+        flush_fn: Callable[[Hashable, List[Any]], List[Any]],
+        max_batch: int,
+        deadline_ms: float,
+        name: str = "batcher",
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._flush_fn = flush_fn
+        self.max_batch = int(max_batch)
+        self.deadline_s = float(deadline_ms) / 1000.0
+        self.name = name
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        # bucket key -> list of (payload, future, enqueue_time);
+        # insertion-ordered so the group with the oldest head is flushed
+        # first on deadline
+        self._groups: "OrderedDict[Hashable, List[Tuple[Any, Future, float]]]" = OrderedDict()
+        self._closed = False
+        self.requests = 0
+        self.flushes_full = 0
+        self.flushes_deadline = 0
+        self.batched_requests = 0  # requests that shared a flush with others
+        self._worker = threading.Thread(
+            target=self._run, name=f"{name}-flush", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+
+    def submit(self, bucket_key: Hashable, payload: Any) -> Future:
+        fut: Future = Future()
+        with self._wake:
+            if self._closed:
+                raise RuntimeError(f"{self.name} is closed")
+            self._groups.setdefault(bucket_key, []).append(
+                (payload, fut, time.monotonic())
+            )
+            self.requests += 1
+            self._wake.notify()
+        return fut
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(len(g) for g in self._groups.values())
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            flushes = self.flushes_full + self.flushes_deadline
+            return {
+                "requests": self.requests,
+                "flushes": flushes,
+                "flushes_full": self.flushes_full,
+                "flushes_deadline": self.flushes_deadline,
+                "batched_requests": self.batched_requests,
+                "mean_batch": (self.requests / flushes) if flushes else 0.0,
+                "queue_depth": sum(len(g) for g in self._groups.values()),
+            }
+
+    def close(self) -> None:
+        """Flush everything still queued, then stop the worker."""
+        with self._wake:
+            if self._closed:
+                return
+            self._closed = True
+            self._wake.notify()
+        self._worker.join()
+
+    # ------------------------------------------------------------------
+
+    def _take_locked(self, key: Hashable) -> List[Tuple[Any, Future, float]]:
+        """Pop at most ``max_batch`` items off a group's head; the remainder
+        stays queued with its own enqueue times (its head ages toward the
+        deadline like any other group)."""
+        group = self._groups[key]
+        if len(group) <= self.max_batch:
+            return self._groups.pop(key)
+        taken, rest = group[: self.max_batch], group[self.max_batch :]
+        self._groups[key] = rest
+        return taken
+
+    def _pop_ready_locked(self, now: float):
+        """The next batch due for flush: any group at max_batch, else one
+        whose head has passed the deadline; None when nothing is due."""
+        for key, group in self._groups.items():
+            if len(group) >= self.max_batch:
+                self.flushes_full += 1
+                return key, self._take_locked(key)
+        for key, group in list(self._groups.items()):
+            if now - group[0][2] >= self.deadline_s:
+                self.flushes_deadline += 1
+                return key, self._take_locked(key)
+        return None
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                while True:
+                    now = time.monotonic()
+                    if self._closed and not self._groups:
+                        return
+                    if self._closed:
+                        # drain: every remaining group is due immediately
+                        key = next(iter(self._groups))
+                        self.flushes_deadline += 1
+                        ready = (key, self._take_locked(key))
+                        break
+                    ready = self._pop_ready_locked(now)
+                    if ready is not None:
+                        break
+                    if self._groups:
+                        next_due = (
+                            min(g[0][2] for g in self._groups.values())
+                            + self.deadline_s
+                        )
+                        self._wake.wait(timeout=max(next_due - now, 0.0))
+                    else:
+                        self._wake.wait()
+                if len(ready[1]) > 1:
+                    self.batched_requests += len(ready[1])
+            key, group = ready
+            payloads = [p for p, _, _ in group]
+            try:
+                results = self._flush_fn(key, payloads)
+                if len(results) != len(group):
+                    raise RuntimeError(
+                        f"{self.name} flush_fn returned {len(results)} results "
+                        f"for {len(group)} payloads"
+                    )
+            except BaseException as exc:  # noqa: BLE001 — fail the futures, keep serving
+                for _, fut, _ in group:
+                    fut.set_exception(exc)
+                continue
+            for (_, fut, _), res in zip(group, results):
+                fut.set_result(res)

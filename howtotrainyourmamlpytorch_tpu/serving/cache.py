@@ -1,0 +1,133 @@
+"""Content-addressed LRU cache of adapted parameter trees.
+
+The serving workload is adapt-once / predict-many: a client uploads a support
+set, the engine runs the inner loop, then answers query requests against the
+adapted weights. Repeat clients (same support set, same checkpoint) are the
+common case — the cache keys adapted weights by
+``(checkpoint fingerprint, support-set digest)`` so they skip the inner loop
+entirely. Bounded by a byte budget (LRU eviction) and a TTL; hit / miss /
+eviction / expiration counters feed the ``/metrics`` endpoint.
+"""
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+CacheKey = Tuple[str, str]
+
+
+def support_digest(x_support, y_support, num_steps: int) -> str:
+    """Content hash of one adapt request: support tensors + shapes + dtypes +
+    the inner-step horizon (the same support set adapted for a different
+    number of steps is a different cache entry)."""
+    h = hashlib.sha256()
+    for arr in (x_support, y_support):
+        a = np.ascontiguousarray(arr)
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    h.update(str(int(num_steps)).encode())
+    return h.hexdigest()
+
+
+def tree_bytes(tree: Any) -> int:
+    return sum(leaf.nbytes for leaf in jax.tree.leaves(tree))
+
+
+class AdaptedWeightCache:
+    """Thread-safe LRU of adapted parameter pytrees.
+
+    ``max_bytes`` bounds the sum of leaf sizes (an entry that alone exceeds
+    the budget is rejected — counted as an eviction); ``ttl_s`` expires
+    entries lazily on access and on insert. ``clock`` is injectable so tests
+    exercise TTL without sleeping."""
+
+    def __init__(
+        self,
+        max_bytes: int,
+        ttl_s: float,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = float(ttl_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> (tree, nbytes, inserted_at); OrderedDict order = LRU order
+        self._entries: "OrderedDict[CacheKey, Tuple[Any, int, float]]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.expirations = 0
+
+    def _expire_locked(self, now: float) -> None:
+        if self.ttl_s <= 0:
+            return
+        dead = [
+            key
+            for key, (_, _, t) in self._entries.items()
+            if now - t > self.ttl_s
+        ]
+        for key in dead:
+            _, nbytes, _ = self._entries.pop(key)
+            self._bytes -= nbytes
+            self.expirations += 1
+
+    def get(self, key: CacheKey) -> Optional[Any]:
+        now = self._clock()
+        with self._lock:
+            self._expire_locked(now)
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry[0]
+
+    def put(self, key: CacheKey, tree: Any) -> None:
+        nbytes = tree_bytes(tree)
+        now = self._clock()
+        with self._lock:
+            self._expire_locked(now)
+            if key in self._entries:
+                _, old_bytes, _ = self._entries.pop(key)
+                self._bytes -= old_bytes
+            if nbytes > self.max_bytes:
+                # one entry over the whole budget: caching it would evict
+                # everything and still bust the bound — refuse
+                self.evictions += 1
+                return
+            self._entries[key] = (tree, nbytes, now)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes:
+                _, (_, evicted_bytes, _) = self._entries.popitem(last=False)
+                self._bytes -= evicted_bytes
+                self.evictions += 1
+
+    def __contains__(self, key: CacheKey) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else 0.0,
+                "evictions": self.evictions,
+                "expirations": self.expirations,
+            }

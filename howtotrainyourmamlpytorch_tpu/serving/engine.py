@@ -1,0 +1,243 @@
+"""The adaptation engine: a saved checkpoint as two compiled entry points.
+
+``adapt(support) -> fast_weights`` runs the inner-loop rollout from
+``core/maml.py`` first-order — no meta-gradient graph, no target forward —
+and ``predict(fast_weights, query) -> probs`` forwards a query batch through
+the adapted weights. Both are jitted per *shape bucket*: request tensors are
+padded up to a small set of compiled (support-size, query-count, task-batch)
+buckets so novel request shapes reuse existing XLA programs instead of
+recompiling. Padded samples carry zero sample-weight, which masks them out of
+the support loss AND the transductive-BN batch statistics
+(models/layers.py::batch_norm), so bucketing never changes predictions.
+
+Batched variants stack same-bucket requests along the task axis — the axis
+``MAMLSystem`` already vmaps over — so a micro-batch flush
+(serving/batcher.py) is one device dispatch regardless of how many clients it
+carries.
+"""
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config, ServingConfig, load_config
+from ..core import MAMLSystem, TrainState
+from ..experiment import checkpoint as ckpt
+
+
+def _bucket_for(size: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= size; an oversize request keeps its exact shape
+    (compiles on demand — correct, just not recompile-proof)."""
+    for b in buckets:
+        if size >= 0 and b >= size:
+            return b
+    return size
+
+
+def _batch_bucket(n: int, max_batch: int) -> int:
+    """Round a task-batch size up to the next power of two (capped at
+    ``max_batch``) so flushes of 3 and 4 requests share one compile."""
+    b = 1
+    while b < n and b < max_batch:
+        b *= 2
+    return min(b, max_batch)
+
+
+def _pad_axis0(arr: np.ndarray, target: int) -> np.ndarray:
+    if arr.shape[0] == target:
+        return arr
+    pad = np.zeros((target - arr.shape[0],) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+class AdaptationEngine:
+    """Wraps a ``MAMLSystem`` + restored train state as a request-serving
+    engine. Accepts either a full ``TrainState`` (e.g. straight out of a
+    live ``ExperimentRunner``) or a ``checkpoint.InferenceState`` (no outer
+    optimizer state — what ``load_for_inference`` returns)."""
+
+    def __init__(
+        self,
+        system: MAMLSystem,
+        state,
+        serving_cfg: Optional[ServingConfig] = None,
+        fingerprint: Optional[str] = None,
+    ):
+        self.system = system
+        self.cfg = system.cfg
+        self.serving = serving_cfg or self.cfg.serving
+        if isinstance(state, ckpt.InferenceState):
+            fingerprint = fingerprint or state.fingerprint
+            state = TrainState(
+                params=state.params,
+                bn_state=state.bn_state,
+                inner_hparams=state.inner_hparams,
+                opt_state=None,
+                step=jnp.asarray(state.step, jnp.int32),
+            )
+        self.state: TrainState = jax.tree.map(jnp.asarray, state)
+        self.fingerprint = fingerprint or "live"
+        self.num_steps = (
+            self.serving.adapt_steps
+            or self.cfg.number_of_evaluation_steps_per_iter
+        )
+        self.num_classes = self.cfg.num_classes_per_set
+        # jit caches keyed by (padded size, task-batch bucket); device
+        # dispatch is serialized by the batcher's worker thread, but direct
+        # engine calls (tests, bench) may race the dict — guard it.
+        self._adapt_jit: Dict[Tuple[int, int], Any] = {}
+        self._predict_jit: Dict[Tuple[int, int], Any] = {}
+        self._jit_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # construction from a run directory
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_run_dir(
+        cls,
+        run_dir: str,
+        checkpoint_idx="best",
+        cfg: Optional[Config] = None,
+        system: Optional[MAMLSystem] = None,
+    ) -> "AdaptationEngine":
+        """Build an engine from a finished (or in-progress) experiment
+        directory: ``config.yaml`` + ``saved_models/train_model_{idx}``.
+        ``checkpoint_idx='best'`` falls back to 'latest' when no best-val
+        checkpoint was written yet."""
+        if cfg is None:
+            cfg = load_config(os.path.join(run_dir, "config.yaml"))
+        save_dir = os.path.join(run_dir, "saved_models")
+        if checkpoint_idx == "best" and not ckpt.checkpoint_exists(save_dir, "best"):
+            checkpoint_idx = "latest"
+        state, _ = ckpt.load_for_inference(save_dir, checkpoint_idx)
+        # serving knobs come from the (possibly overridden) run config even
+        # when the caller supplies a pre-built system
+        return cls(system or MAMLSystem(cfg), state, serving_cfg=cfg.serving)
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+
+    def _compiled_adapt(self, support_size: int, batch: int):
+        key = (support_size, batch)
+        with self._jit_lock:
+            fn = self._adapt_jit.get(key)
+            if fn is None:
+                system, state, num_steps = self.system, self.state, self.num_steps
+
+                def adapt_batched(xs, ys, ws):
+                    return jax.vmap(
+                        lambda x, y, w: system.adapt_fast_weights(
+                            state, x, y, num_steps=num_steps, support_weight=w
+                        )
+                    )(xs, ys, ws)
+
+                fn = self._adapt_jit[key] = jax.jit(adapt_batched)
+        return fn
+
+    def _compiled_predict(self, query_size: int, batch: int):
+        key = (query_size, batch)
+        with self._jit_lock:
+            fn = self._predict_jit.get(key)
+            if fn is None:
+                system, bn_state = self.system, self.state.bn_state
+
+                def predict_batched(fw, xs, ws):
+                    logits = jax.vmap(
+                        lambda p, x, w: system.predict_logits(p, bn_state, x, w)
+                    )(fw, xs, ws)
+                    return jax.nn.softmax(logits, axis=-1)
+
+                fn = self._predict_jit[key] = jax.jit(predict_batched)
+        return fn
+
+    def compile_counts(self) -> Dict[str, int]:
+        with self._jit_lock:
+            return {
+                "adapt_programs": len(self._adapt_jit),
+                "predict_programs": len(self._predict_jit),
+            }
+
+    # ------------------------------------------------------------------
+    # request padding
+    # ------------------------------------------------------------------
+
+    def support_bucket(self, size: int) -> int:
+        return _bucket_for(size, self.serving.support_buckets)
+
+    def query_bucket(self, size: int) -> int:
+        return _bucket_for(size, self.serving.query_buckets)
+
+    @staticmethod
+    def _flatten_support(x_support, y_support) -> Tuple[np.ndarray, np.ndarray]:
+        """Accept [n_way, k, H, W, C] or already-flat [S, H, W, C]."""
+        x = np.asarray(x_support, np.float32)
+        y = np.asarray(y_support, np.int32)
+        if y.ndim == 2:
+            x = x.reshape((-1,) + x.shape[2:])
+            y = y.reshape(-1)
+        return x, y
+
+    # ------------------------------------------------------------------
+    # adapt / predict (single and task-batched)
+    # ------------------------------------------------------------------
+
+    def adapt_batch(self, items: List[Tuple[Any, Any]]):
+        """Adapt a same-bucket group of support sets in one device dispatch.
+        ``items`` is a list of ``(x_support, y_support)``; returns one
+        adapted-parameter pytree per item (device arrays, stackable into the
+        cache)."""
+        flat = [self._flatten_support(x, y) for x, y in items]
+        sizes = {x.shape[0] for x, _ in flat}
+        bucket = self.support_bucket(max(sizes))
+        xs, ys, ws = [], [], []
+        for x, y in flat:
+            s = x.shape[0]
+            xs.append(_pad_axis0(x, bucket))
+            ys.append(_pad_axis0(y, bucket))
+            ws.append(
+                np.concatenate([np.ones(s, np.float32), np.zeros(bucket - s, np.float32)])
+            )
+        n = len(items)
+        b = _batch_bucket(n, self.serving.max_batch_size)
+        while len(xs) < b:  # pad the task axis by replicating the last task
+            xs.append(xs[-1]); ys.append(ys[-1]); ws.append(ws[-1])
+        fn = self._compiled_adapt(bucket, b)
+        stacked = fn(np.stack(xs), np.stack(ys), np.stack(ws))
+        return [jax.tree.map(lambda a, i=i: a[i], stacked) for i in range(n)]
+
+    def adapt(self, x_support, y_support):
+        """Single-task convenience wrapper over :meth:`adapt_batch`."""
+        return self.adapt_batch([(x_support, y_support)])[0]
+
+    def predict_batch(self, items: List[Tuple[Any, Any]]) -> List[np.ndarray]:
+        """Forward a same-bucket group of query batches, each through its own
+        adapted weights, in one device dispatch. ``items`` is a list of
+        ``(fast_weights, x_query)``; returns per-item softmax probabilities
+        [Q_i, num_classes] as host arrays, padding sliced off."""
+        queries = [np.asarray(x, np.float32) for _, x in items]
+        sizes = [q.shape[0] for q in queries]
+        bucket = self.query_bucket(max(sizes))
+        xs = [_pad_axis0(q, bucket) for q in queries]
+        ws = [
+            np.concatenate([np.ones(s, np.float32), np.zeros(bucket - s, np.float32)])
+            for s in sizes
+        ]
+        trees = [fw for fw, _ in items]
+        n = len(items)
+        b = _batch_bucket(n, self.serving.max_batch_size)
+        while len(xs) < b:
+            xs.append(xs[-1]); ws.append(ws[-1]); trees.append(trees[-1])
+        stacked_fw = jax.tree.map(lambda *leaves: jnp.stack(leaves), *trees)
+        fn = self._compiled_predict(bucket, b)
+        probs = np.asarray(fn(stacked_fw, np.stack(xs), np.stack(ws)))
+        return [probs[i, : sizes[i]] for i in range(n)]
+
+    def predict(self, fast_weights, x_query) -> np.ndarray:
+        """Single-request convenience wrapper over :meth:`predict_batch`."""
+        return self.predict_batch([(fast_weights, x_query)])[0]

@@ -1,0 +1,67 @@
+"""Serving latency metrics: per-phase ring buffers -> p50/p95/p99.
+
+Same spirit as ``utils/profiling.py`` (measure, don't guess), but for the
+request path: each phase ("adapt", "adapt_cached", "predict", "queue") keeps
+a bounded window of wall-clock latencies; ``summary()`` is the ``/metrics``
+payload. A ring buffer (not a running histogram) keeps percentiles exact over
+the recent window and forgets cold-start compiles at window pace.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict
+
+import numpy as np
+
+
+class LatencyStats:
+    def __init__(self, window: int = 2048):
+        self.window = int(window)
+        self._lock = threading.Lock()
+        self._phases: Dict[str, deque] = {}
+        self._counts: Dict[str, int] = {}
+
+    def record(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            buf = self._phases.get(phase)
+            if buf is None:
+                buf = self._phases[phase] = deque(maxlen=self.window)
+                self._counts[phase] = 0
+            buf.append(seconds)
+            self._counts[phase] += 1
+
+    def time(self, phase: str):
+        """Context manager: ``with stats.time("adapt"): ...``"""
+        return _Timer(self, phase)
+
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            out = {}
+            for phase, buf in self._phases.items():
+                arr = np.asarray(buf, np.float64) * 1e3
+                p50, p95, p99 = np.percentile(arr, [50, 95, 99])
+                out[phase] = {
+                    "count": self._counts[phase],
+                    "window": len(arr),
+                    "mean_ms": round(float(arr.mean()), 3),
+                    "p50_ms": round(float(p50), 3),
+                    "p95_ms": round(float(p95), 3),
+                    "p99_ms": round(float(p99), 3),
+                    "max_ms": round(float(arr.max()), 3),
+                }
+            return out
+
+
+class _Timer:
+    def __init__(self, stats: LatencyStats, phase: str):
+        self._stats = stats
+        self._phase = phase
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._stats.record(self._phase, time.monotonic() - self._t0)
+        return False

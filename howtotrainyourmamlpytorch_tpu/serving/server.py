@@ -1,0 +1,236 @@
+"""The serving front-end: in-process API + a thin stdlib HTTP JSON layer.
+
+``ServingFrontend`` wires the pieces together — engine (compiled adapt /
+predict), adapted-weight cache, micro-batchers, latency metrics — behind the
+request API a client sees:
+
+- ``adapt(x_support, y_support) -> {adaptation_id, cached, ...}``: run (or
+  skip, on cache hit) the inner loop; the returned id names the cached
+  adapted weights.
+- ``predict(adaptation_id, x_query) -> probs``: forward queries through the
+  cached adapted weights.
+- ``adapt_predict(...)``: both in one call, for one-shot clients.
+- ``metrics() / healthz()``: the observability surface.
+
+The HTTP layer (``ThreadingHTTPServer`` + JSON bodies) is deliberately
+stdlib-only — no framework dependency — and thin: every handler parses JSON,
+calls the frontend, serializes the result. Concurrency comes from the
+threaded server (one thread per in-flight request) feeding the batchers,
+whose single worker serializes device dispatch.
+"""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..config import Config, ServingConfig
+from ..core import MAMLSystem
+from .batcher import MicroBatcher
+from .cache import AdaptedWeightCache, support_digest
+from .engine import AdaptationEngine
+from .metrics import LatencyStats
+
+
+class UnknownAdaptationError(KeyError):
+    """predict() named an adaptation id that is not (or no longer) cached."""
+
+
+class ServingFrontend:
+    def __init__(self, engine: AdaptationEngine, serving_cfg: Optional[ServingConfig] = None):
+        self.engine = engine
+        self.serving = serving_cfg or engine.serving
+        self.cache = AdaptedWeightCache(
+            max_bytes=self.serving.cache_max_bytes, ttl_s=self.serving.cache_ttl_s
+        )
+        self.latency = LatencyStats(self.serving.latency_window)
+        self._adapt_batcher = MicroBatcher(
+            lambda bucket, payloads: self.engine.adapt_batch(payloads),
+            max_batch=self.serving.max_batch_size,
+            deadline_ms=self.serving.batch_deadline_ms,
+            name="adapt",
+        )
+        self._predict_batcher = MicroBatcher(
+            lambda bucket, payloads: self.engine.predict_batch(payloads),
+            max_batch=self.serving.max_batch_size,
+            deadline_ms=self.serving.batch_deadline_ms,
+            name="predict",
+        )
+        self._started = time.monotonic()
+        self._closed = False
+
+    # ------------------------------------------------------------------
+
+    def _cache_key(self, digest: str) -> Tuple[str, str]:
+        return (self.engine.fingerprint, digest)
+
+    def adapt(self, x_support, y_support) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        x, y = self.engine._flatten_support(x_support, y_support)
+        digest = support_digest(x, y, self.engine.num_steps)
+        key = self._cache_key(digest)
+        cached = self.cache.get(key) is not None
+        if not cached:
+            bucket = self.engine.support_bucket(x.shape[0])
+            fast_weights = self._adapt_batcher.submit(bucket, (x, y)).result()
+            self.cache.put(key, fast_weights)
+        elapsed = time.monotonic() - t0
+        self.latency.record("adapt_cached" if cached else "adapt", elapsed)
+        return {
+            "adaptation_id": digest,
+            "cached": cached,
+            "support_size": int(x.shape[0]),
+            "latency_ms": round(elapsed * 1e3, 3),
+        }
+
+    def predict(self, adaptation_id: str, x_query) -> np.ndarray:
+        t0 = time.monotonic()
+        fast_weights = self.cache.get(self._cache_key(adaptation_id))
+        if fast_weights is None:
+            raise UnknownAdaptationError(
+                f"unknown or expired adaptation_id {adaptation_id!r}; "
+                "re-send the support set via /adapt"
+            )
+        x = np.asarray(x_query, np.float32)
+        bucket = self.engine.query_bucket(x.shape[0])
+        probs = self._predict_batcher.submit(bucket, (fast_weights, x)).result()
+        self.latency.record("predict", time.monotonic() - t0)
+        return probs
+
+    def adapt_predict(self, x_support, y_support, x_query) -> Dict[str, Any]:
+        info = self.adapt(x_support, y_support)
+        probs = self.predict(info["adaptation_id"], x_query)
+        return {**info, "probs": probs}
+
+    # ------------------------------------------------------------------
+
+    def healthz(self) -> Dict[str, Any]:
+        return {
+            "status": "ok",
+            "platform": jax.default_backend(),
+            "checkpoint_fingerprint": self.engine.fingerprint,
+            "model": self.engine.system.model.name,
+            "num_classes": self.engine.num_classes,
+            "adapt_steps": self.engine.num_steps,
+            "uptime_s": round(time.monotonic() - self._started, 1),
+        }
+
+    def metrics(self) -> Dict[str, Any]:
+        return {
+            "latency": self.latency.summary(),
+            "cache": self.cache.stats(),
+            "adapt_batcher": self._adapt_batcher.stats(),
+            "predict_batcher": self._predict_batcher.stats(),
+            "compiled": self.engine.compile_counts(),
+            "uptime_s": round(time.monotonic() - self._started, 1),
+        }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._adapt_batcher.close()
+        self._predict_batcher.close()
+
+
+def frontend_from_run_dir(
+    run_dir: str, checkpoint_idx="best", cfg: Optional[Config] = None
+) -> ServingFrontend:
+    engine = AdaptationEngine.from_run_dir(run_dir, checkpoint_idx, cfg=cfg)
+    return ServingFrontend(engine)
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer
+# ---------------------------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the frontend is attached to the server instance by make_http_server
+    protocol_version = "HTTP/1.1"
+
+    def _send_json(self, code: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> Dict[str, Any]:
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def log_message(self, fmt, *args):  # quiet by default; metrics cover it
+        pass
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        frontend: ServingFrontend = self.server.frontend  # type: ignore[attr-defined]
+        try:
+            if self.path == "/healthz":
+                self._send_json(200, frontend.healthz())
+            elif self.path == "/metrics":
+                self._send_json(200, frontend.metrics())
+            else:
+                self._send_json(404, {"error": f"unknown path {self.path}"})
+        except Exception as exc:  # noqa: BLE001 — keep the server alive
+            self._send_json(500, {"error": f"internal error: {exc!r}"})
+
+    def do_POST(self):  # noqa: N802
+        frontend: ServingFrontend = self.server.frontend  # type: ignore[attr-defined]
+        try:
+            req = self._read_json()
+            if self.path == "/adapt":
+                out = frontend.adapt(req["x_support"], req["y_support"])
+                self._send_json(200, out)
+            elif self.path == "/predict":
+                probs = frontend.predict(req["adaptation_id"], req["x_query"])
+                self._send_json(200, {"probs": probs.tolist()})
+            elif self.path == "/adapt_predict":
+                out = frontend.adapt_predict(
+                    req["x_support"], req["y_support"], req["x_query"]
+                )
+                out["probs"] = out["probs"].tolist()
+                self._send_json(200, out)
+            else:
+                self._send_json(404, {"error": f"unknown path {self.path}"})
+        except UnknownAdaptationError as exc:
+            self._send_json(404, {"error": str(exc)})
+        except (KeyError, ValueError, TypeError) as exc:
+            self._send_json(400, {"error": f"bad request: {exc!r}"})
+        except Exception as exc:  # noqa: BLE001 — keep the server alive
+            self._send_json(500, {"error": f"internal error: {exc!r}"})
+
+
+def make_http_server(
+    frontend: ServingFrontend, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """Bind (port 0 = ephemeral, for tests) but do not serve; the caller owns
+    ``serve_forever`` / ``shutdown``."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.frontend = frontend  # type: ignore[attr-defined]
+    server.daemon_threads = True
+    return server
+
+
+def serve_forever(frontend: ServingFrontend, host: str, port: int) -> None:
+    server = make_http_server(frontend, host, port)
+    addr = server.server_address
+    print(
+        f"serving on http://{addr[0]}:{addr[1]} "
+        f"(checkpoint {frontend.engine.fingerprint[:12]}, "
+        f"platform {jax.default_backend()})",
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+        frontend.close()

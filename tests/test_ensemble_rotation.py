@@ -48,11 +48,15 @@ def make_runner(toy_dataset, tmp_path, **overrides):
         load_into_memory=True,
         num_dataprovider_workers=2,
         train_val_test_split=(0.6, 0.2, 0.2),
+        # patches-GEMM convs: GSPMD's convolution handler CHECK-crashes on
+        # the dp-sharded batch-grouped convs of this program family on this
+        # jaxlib (see tests/test_runner.py::runner_config)
+        conv_via_patches=True,
     )
     base.update(overrides)
     cfg = Config(**base)
     system = MAMLSystem(
-        cfg, model=build_vgg((28, 28, 1), cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4)
+        cfg, model=build_vgg((28, 28, 1), cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4, conv_via_patches=True)
     )
     return cfg, ExperimentRunner(cfg, system=system)
 

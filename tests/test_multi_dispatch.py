@@ -140,11 +140,16 @@ def test_runner_fused_eval_smoke(toy_dataset, tmp_path):  # noqa: F811
         eval_fused_dispatch=True,
         parallel=ParallelConfig(dp=2),
         experiment_root=str(tmp_path),
+        # patches-GEMM convs: GSPMD's convolution handler CHECK-crashes on
+        # the dp-sharded batch-grouped convs of this program family on this
+        # jaxlib (see tests/test_runner.py::runner_config)
+        conv_via_patches=True,
     )
     system = MAMLSystem(
         cfg,
         model=build_vgg(
-            (28, 28, 1), cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4
+            (28, 28, 1), cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4,
+            conv_via_patches=True,
         ),
     )
     runner = ExperimentRunner(cfg, system=system)
@@ -174,11 +179,14 @@ def test_runner_epoch_with_multi_dispatch(toy_dataset, tmp_path):  # noqa: F811
             # dp mesh: the K=2 arm exercises chunk_sharding's [K, B] layout
             parallel=ParallelConfig(dp=2),
             experiment_root=str(tmp_path / name),
+            # patches-GEMM convs (see tests/test_runner.py::runner_config)
+            conv_via_patches=True,
         )
         system = MAMLSystem(
             cfg,
             model=build_vgg(
-                (28, 28, 1), cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4
+                (28, 28, 1), cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4,
+                conv_via_patches=True,
             ),
         )
         runner = ExperimentRunner(cfg, system=system)

@@ -56,6 +56,12 @@ def test_sharded_train_step_matches_single_device():
     )
 
 
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="needs jax.shard_map with VMA typing (newer jax); the installed "
+    "jax only has experimental shard_map, whose rep-checker cannot infer the "
+    "replication of a grad-of-replicated-arg output (auto-psum untestable)",
+)
 def test_explicit_shard_map_psum_meta_grad():
     """Unit test of the meta-grad collective (SURVEY.md §4). Under JAX's VMA
     typing, ``jax.grad`` w.r.t. a *replicated* arg inside ``shard_map``
@@ -194,8 +200,11 @@ def test_dp_mp_sharded_step_matches_single_device():
     )
 
     n_way, k, t = 4, 2, 2
-    cfg = tiny_config(batch_size=4, num_classes_per_set=n_way)
-    model = build_vgg(TINY_SHAPE, n_way, num_stages=2, cnn_num_filters=8)
+    # patches-GEMM convs: GSPMD's convolution handler CHECK-crashes on the
+    # dp-sharded batch-grouped convs of this program family on this jaxlib
+    # (see tests/test_runner.py::runner_config)
+    cfg = tiny_config(batch_size=4, num_classes_per_set=n_way, conv_via_patches=True)
+    model = build_vgg(TINY_SHAPE, n_way, num_stages=2, cnn_num_filters=8, conv_via_patches=True)
     system = MAMLSystem(cfg, model=model)
     batch = _as_jnp(synthetic_batch(4, n_way, k, t, TINY_SHAPE, seed=7))
 

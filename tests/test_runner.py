@@ -49,13 +49,19 @@ def runner_config(toy_dataset, tmp_path, **overrides):
         load_into_memory=True,
         num_dataprovider_workers=2,
         train_val_test_split=(0.6, 0.2, 0.2),  # 20 toy classes need a real val split
+        # patches-GEMM convs: the native conv path CHECK-crashes GSPMD's
+        # convolution handler (convolution_handler.cc ShapeUtil::Compatible)
+        # on this jaxlib when the dp-sharded meta-batch turns the per-task
+        # vmapped convs into batch-grouped convolutions — the exact crash
+        # family conv_via_patches exists to dodge (see ParallelConfig.tp_convs)
+        conv_via_patches=True,
     )
     base.update(overrides)
     return Config(**base)
 
 
 def small_system(cfg):
-    return MAMLSystem(cfg, model=build_vgg((28, 28, 1), cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4))
+    return MAMLSystem(cfg, model=build_vgg((28, 28, 1), cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4, conv_via_patches=True))
 
 
 def test_end_to_end_artifacts_and_resume(toy_dataset, tmp_path):

@@ -44,6 +44,10 @@ def toy_cfg(toy_dataset, **overrides):
         load_into_memory=True,
         num_dataprovider_workers=2,
         train_val_test_split=(0.6, 0.2, 0.2),
+        # patches-GEMM convs: GSPMD's convolution handler CHECK-crashes on
+        # the dp-sharded batch-grouped convs of this program family on this
+        # jaxlib (see tests/test_runner.py::runner_config)
+        conv_via_patches=True,
     )
     base.update(overrides)
     return Config(**base)
@@ -54,7 +58,7 @@ def test_total_epochs_before_pause_limits_run(toy_dataset, tmp_path):
     total_epochs is larger; resuming continues from the pause point."""
     cfg = toy_cfg(toy_dataset, total_epochs_before_pause=2,
                   experiment_root=str(tmp_path), experiment_name="pause")
-    system = MAMLSystem(cfg, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4))
+    system = MAMLSystem(cfg, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4, conv_via_patches=True))
     runner = ExperimentRunner(cfg, system=system)
     runner.run_experiment()
     import os
@@ -62,7 +66,7 @@ def test_total_epochs_before_pause_limits_run(toy_dataset, tmp_path):
     assert len(rows) == 2  # paused, not 5
     cfg2 = toy_cfg(toy_dataset, total_epochs_before_pause=2,
                    experiment_root=str(tmp_path), experiment_name="pause")
-    system2 = MAMLSystem(cfg2, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4))
+    system2 = MAMLSystem(cfg2, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4, conv_via_patches=True))
     runner2 = ExperimentRunner(cfg2, system=system2)
     assert runner2.start_epoch == 2
     runner2.run_experiment()
@@ -85,10 +89,10 @@ def test_first_order_to_second_order_epoch_switch(toy_dataset):
     second order iff second_order and epoch > first_order_to_second_order_epoch
     (reference few_shot_learning_system.py:288-289)."""
     cfg = toy_cfg(toy_dataset, first_order_to_second_order_epoch=2)
-    system = MAMLSystem(cfg, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4))
+    system = MAMLSystem(cfg, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4, conv_via_patches=True))
     assert not system.use_second_order(0)
     assert not system.use_second_order(2)
     assert system.use_second_order(3)
     cfg2 = toy_cfg(toy_dataset, second_order=False)
-    system2 = MAMLSystem(cfg2, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4))
+    system2 = MAMLSystem(cfg2, model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4, conv_via_patches=True))
     assert not system2.use_second_order(100)
