@@ -136,6 +136,81 @@ class ServingConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Fault tolerance knobs (resilience/ package; no reference equivalent —
+    the reference crashes on the first NaN, corrupt checkpoint, or SIGKILL).
+    Injection specs are OFF by default: with ``faults`` empty (and no
+    ``HTYMP_FAULTS`` env var) every seam is inert and behavior is
+    bit-identical to a build without the subsystem."""
+
+    # --- NaN/Inf step sentinel (experiment/runner.py) ---
+    # Detect a non-finite outer-step loss and discard that step (the state
+    # before it is restored; the episode stream moves on past the poisoned
+    # batch). Detection fetches each step's scalar loss with a ONE-STEP lag,
+    # so one dispatch stays in flight and episode assembly still overlaps
+    # device compute; disable to restore unbounded dispatch depth (and the
+    # pre-resilience behavior of training straight through NaNs).
+    nan_guard: bool = True
+    # K: consecutive discarded steps before rolling the TrainState back to
+    # the last good checkpointed state (kept in memory by the runner)
+    max_consecutive_bad_steps: int = 3
+    # each rollback multiplies the outer LR schedule by this factor
+    # (MAMLSystem.scale_meta_lr) — NaNs from an optimization blow-up need a
+    # smaller step, not the same one replayed
+    rollback_lr_backoff: float = 0.5
+    # M: rollbacks spent before the runner gives up with the permanent
+    # exit code 3 (scripts/sweep.sh: diverged, do not restart)
+    max_rollbacks: int = 2
+    # --- preemption (experiment/runner.py) ---
+    # SIGTERM/SIGINT -> emergency 'latest' checkpoint carrying the mid-epoch
+    # iteration cursor, then exit with preemption_exit_code (75 =
+    # EX_TEMPFAIL) — scripts/sweep.sh restarts it without burning an attempt
+    preemption_save: bool = True
+    preemption_exit_code: int = 75
+    # --- loader transient-I/O retry (data/loader.py) ---
+    loader_io_retries: int = 2
+    loader_io_backoff_s: float = 0.05
+    # --- serving (serving/server.py, serving/batcher.py) ---
+    request_deadline_s: float = 30.0  # per request; exceeded -> HTTP 504
+    max_queue_depth: int = 64  # per batcher; beyond -> shed (503 + Retry-After)
+    shed_retry_after_s: float = 1.0
+    breaker_failure_threshold: int = 5
+    breaker_cooldown_s: float = 10.0
+    breaker_half_open_probes: int = 1
+    # --- fault injection (resilience/faults.py; spec grammar documented
+    # there; HTYMP_FAULTS env specs are merged in at injector build) ---
+    faults: List[str] = field(default_factory=list)
+    fault_seed: int = 0
+
+    def __post_init__(self):
+        self.faults = list(self.faults)
+        # parse eagerly so a typo'd drill spec fails at config load, not
+        # hours into the run it was meant to harden
+        from .resilience.faults import FaultSpec  # local: keep resilience config-free
+
+        for spec in self.faults:
+            FaultSpec.parse(spec)
+        for name in (
+            "max_consecutive_bad_steps",
+            "max_rollbacks",
+            "loader_io_retries",
+            "max_queue_depth",
+        ):
+            if getattr(self, name) < 0:
+                raise ValueError(f"resilience.{name} must be >= 0, got {getattr(self, name)}")
+        # match CircuitBreaker's own constructor contract so a bad value
+        # bounces here, not at serving startup hours later
+        for name in ("breaker_failure_threshold", "breaker_half_open_probes"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"resilience.{name} must be >= 1, got {getattr(self, name)}")
+        if not 0.0 < self.rollback_lr_backoff <= 1.0:
+            raise ValueError(
+                f"resilience.rollback_lr_backoff must be in (0, 1], "
+                f"got {self.rollback_lr_backoff}"
+            )
+
+
+@dataclass
 class Config:
     # --- data provider (reference config.yaml:11-20,63-65) ---
     num_dataprovider_workers: int = 4
@@ -270,6 +345,8 @@ class Config:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     # --- few-shot serving engine (serving/ package; no reference equivalent) ---
     serving: ServingConfig = field(default_factory=ServingConfig)
+    # --- fault tolerance (resilience/ package; no reference equivalent) ---
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     compute_dtype: str = "float32"  # or "bfloat16" for MXU-friendly compute
     remat_inner_steps: bool = True  # jax.checkpoint per inner step (SURVEY §5.7)
     # Fully unroll the inner-step lax.scan: removes scan sequencing overhead
@@ -428,8 +505,8 @@ def _dataclass_from_dict(cls, data: Dict[str, Any]):
         if name not in data:
             continue
         value = data[name]
-        if name in ("dataset", "inner_optim", "parallel", "serving"):
-            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig, "serving": ServingConfig}[name]
+        if name in ("dataset", "inner_optim", "parallel", "serving", "resilience"):
+            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig, "serving": ServingConfig, "resilience": ResilienceConfig}[name]
             presets = {"dataset": DATASET_PRESETS, "inner_optim": INNER_OPTIM_PRESETS}.get(name, {})
             if isinstance(value, str):
                 if value not in presets:

@@ -128,6 +128,9 @@ class MAMLSystem:
                 )
             kwargs["fused"] = True
         self.inner_opt = build_inner_optimizer(io.kind, **kwargs)
+        # cumulative outer-LR scale (1.0 = the configured schedule); the
+        # resilience NaN-rollback ladder shrinks it via scale_meta_lr
+        self.meta_lr_scale = 1.0
         self.schedule = cosine_epoch_schedule(
             cfg.meta_learning_rate,
             cfg.min_learning_rate,
@@ -216,6 +219,29 @@ class MAMLSystem:
 
     def num_params(self, state: TrainState) -> int:
         return tree_count_params({"params": state.params, "hparams": state.inner_hparams})
+
+    def scale_meta_lr(self, factor: float) -> None:
+        """Shrink the outer LR schedule in place (resilience rollback
+        backoff, experiment/runner.py::_note_bad_step): rebuilds the cosine
+        schedule and the optax transform at ``meta_lr_scale * factor`` of the
+        configured rates and drops every compiled train/eval program so the
+        next step traces against the new schedule. The optimizer *state*
+        (Adam moments + count) is structurally unchanged — a restored
+        checkpoint keeps working across the swap. Recompiles are paid only
+        when a rollback actually happens."""
+        self.meta_lr_scale *= float(factor)
+        cfg = self.cfg
+        self.schedule = cosine_epoch_schedule(
+            cfg.meta_learning_rate * self.meta_lr_scale,
+            cfg.min_learning_rate * self.meta_lr_scale,
+            cfg.total_epochs,
+            cfg.total_iter_per_epoch,
+        )
+        self.outer_opt = optax.adam(learning_rate=self.schedule)
+        self._train_step_cache.clear()
+        self._train_multi_cache.clear()
+        self._eval_step = jax.jit(self._eval_step_impl)
+        self._eval_multi = None
 
     # ------------------------------------------------------------------
     # inner rollout (per task)

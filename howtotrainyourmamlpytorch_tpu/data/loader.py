@@ -27,6 +27,8 @@ from typing import Dict, Iterator, Optional
 import numpy as np
 
 from ..config import Config
+from ..resilience.faults import NULL_INJECTOR
+from ..resilience.retry import retry_call
 from .dataset import FewShotDataset
 
 
@@ -48,6 +50,7 @@ class MetaLearningDataLoader:
         current_iter: int = 0,
         data_root: Optional[str] = None,
         host_shard: Optional[tuple] = None,
+        injector=NULL_INJECTOR,
     ):
         """``host_shard=(process_index, process_count)`` makes this loader
         materialize only its host's contiguous slice of each *global*
@@ -67,6 +70,8 @@ class MetaLearningDataLoader:
         else:
             self._local_lo, self._local_hi = 0, self.batch_size
         self.num_workers = max(cfg.num_dataprovider_workers, 1)
+        self._injector = injector
+        self.io_retries_used = 0  # transient episode-I/O retries (observability)
         self.train_episodes_produced = 0
         self.continue_from_iter(current_iter)
         # persistent episode-assembly pool: one per loader, not per batch —
@@ -115,25 +120,49 @@ class MetaLearningDataLoader:
     # ------------------------------------------------------------------
 
     def _build_batch(self, split: str, base: int, augment: bool) -> Dict[str, np.ndarray]:
-        """Assemble the batch whose first global episode index is ``base``."""
-        ds = self.dataset
-        # this host's slice of the global batch (whole batch by default)
-        seeds = [
-            ds.episode_seed(split, base + j)
-            for j in range(self._local_lo, self._local_hi)
-        ]
-        # fast path: whole batch assembled by one native C++ call
-        # (gather+rot90+normalize+pack in native threads; ctypes releases
-        # the GIL, so prefetch still overlaps the device step)
-        batch = ds.sample_episode_batch(split, seeds, augment)
-        if batch is not None:
-            return batch
-        episodes = list(
-            self._episode_pool.map(
-                lambda s: ds.sample_episode(split, s, augment), seeds
+        """Assemble the batch whose first global episode index is ``base``.
+        Episode assembly is wrapped in a bounded transient-I/O retry
+        (resilience.loader_io_*): a flaky read (cold NFS, an injected
+        ``loader.episode`` fault) is retried with backoff instead of killing
+        the prefetch pipeline; a persistent failure still propagates."""
+        res = self.cfg.resilience
+
+        def attempt() -> Dict[str, np.ndarray]:
+            self._injector.fire("loader.episode")
+            ds = self.dataset
+            # this host's slice of the global batch (whole batch by default)
+            seeds = [
+                ds.episode_seed(split, base + j)
+                for j in range(self._local_lo, self._local_hi)
+            ]
+            # fast path: whole batch assembled by one native C++ call
+            # (gather+rot90+normalize+pack in native threads; ctypes releases
+            # the GIL, so prefetch still overlaps the device step)
+            batch = ds.sample_episode_batch(split, seeds, augment)
+            if batch is not None:
+                return batch
+            episodes = list(
+                self._episode_pool.map(
+                    lambda s: ds.sample_episode(split, s, augment), seeds
+                )
             )
+            return _stack(episodes)
+
+        def note_retry(attempt_idx, exc):
+            self.io_retries_used += 1
+            print(
+                f"warning: episode I/O failed ({exc}); retry "
+                f"{attempt_idx + 1}/{res.loader_io_retries}",
+                flush=True,
+            )
+
+        return retry_call(
+            attempt,
+            retries=res.loader_io_retries,
+            backoff_s=res.loader_io_backoff_s,
+            retry_on=(OSError,),
+            on_retry=note_retry,
         )
-        return _stack(episodes)
 
     def _prefetched(self, build, total: int, advance_per_yield: int) -> Iterator:
         """Drive ``build(i)`` for i in [0, total) through the bounded

@@ -9,6 +9,15 @@ hyperparams + outer optimizer state + step counter) plus runner bookkeeping
 
 File naming mirrors the reference ("{name}_{idx}" with idx = epoch or
 'latest'); ``max_models_to_save`` rotation matches ``config.yaml:12``.
+
+Integrity (resilience subsystem): every checkpoint since format 2 wraps the
+msgpack body with its sha256 digest; every load verifies it. A mismatch (torn
+write, bit rot, truncation — or an injected ``checkpoint.read`` fault) raises
+:class:`CheckpointCorruptError`; :func:`quarantine` renames the bad file to
+``*.corrupt`` so rotation and epoch discovery never see it again, and
+:func:`load_latest_with_fallback` walks latest -> newest valid epoch so a
+corrupt ``train_model_latest`` degrades a resume by one epoch instead of
+crashing it. Pre-format-2 files (no digest) still load, unverified.
 """
 
 import hashlib
@@ -21,8 +30,15 @@ import numpy as np
 from flax import serialization
 
 from ..core.train_state import TrainState
+from ..resilience.faults import NULL_INJECTOR
 
 MODEL_NAME = "train_model"
+
+CHECKPOINT_FORMAT = 2  # 1 (implicit): bare payload; 2: sha256-wrapped body
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The file failed its embedded-digest check or cannot be decoded."""
 
 
 class InferenceState(NamedTuple):
@@ -46,24 +62,84 @@ def _path(save_dir: str, idx) -> str:
 
 
 def _serialize(state: TrainState, bookkeeping: Dict[str, Any]) -> bytes:
-    payload = {
-        "network": serialization.to_bytes(jax.tree.map(np.asarray, state)),
-        "bookkeeping": bookkeeping,
-    }
-    return serialization.msgpack_serialize(payload)
+    body = serialization.msgpack_serialize(
+        {
+            "network": serialization.to_bytes(jax.tree.map(np.asarray, state)),
+            "bookkeeping": bookkeeping,
+        }
+    )
+    # format 2: the body's digest rides inside the file, so a load can tell
+    # "file I wrote" from "file something mangled" without a sidecar
+    return serialization.msgpack_serialize(
+        {
+            "format": CHECKPOINT_FORMAT,
+            "sha256": hashlib.sha256(body).hexdigest(),
+            "body": body,
+        }
+    )
 
 
-def _write_atomic(target: str, blob: bytes) -> None:
+def _read_payload(path: str, injector=NULL_INJECTOR) -> Tuple[Dict[str, Any], bytes]:
+    """Read + digest-verify one checkpoint file -> (payload dict, raw blob).
+    Decode failures and digest mismatches both raise
+    :class:`CheckpointCorruptError` (a truncated msgpack and a bit-flipped one
+    deserve the same quarantine)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    blob = injector.fire_bytes("checkpoint.read", blob)
+    try:
+        outer = serialization.msgpack_restore(blob)
+    except Exception as exc:
+        raise CheckpointCorruptError(f"{path}: undecodable checkpoint ({exc!r})") from exc
+    if isinstance(outer, dict) and "body" in outer and "sha256" in outer:
+        body = outer["body"]
+        digest = hashlib.sha256(body).hexdigest()
+        if digest != outer["sha256"]:
+            raise CheckpointCorruptError(
+                f"{path}: sha256 mismatch (stored {outer['sha256'][:12]}…, "
+                f"computed {digest[:12]}…) — corrupt checkpoint"
+            )
+        try:
+            payload = serialization.msgpack_restore(body)
+        except Exception as exc:
+            raise CheckpointCorruptError(f"{path}: undecodable body ({exc!r})") from exc
+    else:
+        # pre-format-2 file: no digest to verify — accept as-is so old runs
+        # (and their forensic tooling, scripts/checkpoint_autopsy.py) keep
+        # loading
+        payload = outer
+    if not isinstance(payload, dict) or "network" not in payload:
+        raise CheckpointCorruptError(f"{path}: payload missing 'network'")
+    return payload, blob
+
+
+def _write_atomic(target: str, blob: bytes, injector=NULL_INJECTOR) -> None:
+    blob = injector.fire_bytes("checkpoint.write", blob)
     tmp = target + ".tmp"
     with open(tmp, "wb") as f:
         f.write(blob)
     os.replace(tmp, target)  # atomic: preemption-safe (SURVEY.md §5.3)
 
 
-def save_named(save_dir: str, state: TrainState, bookkeeping: Dict[str, Any], idx) -> str:
+def quarantine(save_dir: str, idx) -> Optional[str]:
+    """Rename a corrupt checkpoint to ``*.corrupt`` (kept for forensics,
+    invisible to ``available_epochs``/``checkpoint_exists``). Returns the new
+    path, or None if the file was already gone."""
+    path = _path(save_dir, idx)
+    if not os.path.exists(path):
+        return None
+    target = path + ".corrupt"
+    os.replace(path, target)
+    return target
+
+
+def save_named(
+    save_dir: str, state: TrainState, bookkeeping: Dict[str, Any], idx,
+    injector=NULL_INJECTOR,
+) -> str:
     """Write a single checkpoint file under any idx (e.g. 'best')."""
     path = _path(save_dir, idx)
-    _write_atomic(path, _serialize(state, bookkeeping))
+    _write_atomic(path, _serialize(state, bookkeeping), injector)
     return path
 
 
@@ -74,6 +150,7 @@ def save_checkpoint(
     epoch: int,
     max_models_to_save: int = 5,
     val_acc_by_epoch: Optional[Dict[int, float]] = None,
+    injector=NULL_INJECTOR,
 ) -> str:
     """Write ``train_model_{epoch}`` + ``train_model_latest`` and rotate.
 
@@ -84,7 +161,7 @@ def save_checkpoint(
     blob = _serialize(state, bookkeeping)
     path = _path(save_dir, epoch)
     for target in (path, _path(save_dir, "latest")):
-        _write_atomic(target, blob)
+        _write_atomic(target, blob, injector)
     _rotate(save_dir, max_models_to_save, val_acc_by_epoch)
     return path
 
@@ -102,19 +179,52 @@ def _rotate(save_dir: str, keep: int, val_acc_by_epoch: Optional[Dict[int, float
 
 
 def load_checkpoint(
-    save_dir: str, idx, template_state: TrainState
+    save_dir: str, idx, template_state: TrainState, injector=NULL_INJECTOR
 ) -> Tuple[TrainState, Dict[str, Any]]:
     """``idx`` is an epoch number or 'latest' (reference load_model API,
     ``few_shot_learning_system.py:419-432``). ``template_state`` supplies the
-    pytree structure (an ``init_train_state()`` result)."""
-    with open(_path(save_dir, idx), "rb") as f:
-        payload = serialization.msgpack_restore(f.read())
+    pytree structure (an ``init_train_state()`` result). Digest-verified:
+    raises :class:`CheckpointCorruptError` on a bad file."""
+    payload, _ = _read_payload(_path(save_dir, idx), injector)
     template = jax.tree.map(np.asarray, template_state)
     state = serialization.from_bytes(template, payload["network"])
     return TrainState(*state), payload["bookkeeping"]
 
 
-def load_for_inference(save_dir: str, idx) -> Tuple[InferenceState, Dict[str, Any]]:
+def load_latest_with_fallback(
+    save_dir: str, template_state: TrainState, injector=NULL_INJECTOR
+) -> Tuple[TrainState, Dict[str, Any], Any]:
+    """Resume chain: ``latest`` first, then per-epoch files newest-first.
+    Every corrupt candidate is quarantined (``*.corrupt``) and the chain moves
+    on, so one torn write costs one epoch of progress, not the run. Returns
+    ``(state, bookkeeping, idx_used)``; raises
+    :class:`CheckpointCorruptError` only when NO candidate survives."""
+    candidates = ["latest"] + [
+        e for e in reversed(available_epochs(save_dir))
+    ]
+    errors = []
+    for idx in candidates:
+        if not checkpoint_exists(save_dir, idx):
+            continue
+        try:
+            state, bookkeeping = load_checkpoint(save_dir, idx, template_state, injector)
+            return state, bookkeeping, idx
+        except CheckpointCorruptError as exc:
+            quarantined = quarantine(save_dir, idx)
+            errors.append(str(exc))
+            print(
+                f"warning: checkpoint {MODEL_NAME}_{idx} is corrupt — "
+                f"quarantined to {quarantined}; falling back",
+                flush=True,
+            )
+    raise CheckpointCorruptError(
+        f"no valid checkpoint under {save_dir}: " + "; ".join(errors)
+    )
+
+
+def load_for_inference(
+    save_dir: str, idx, injector=NULL_INJECTOR
+) -> Tuple[InferenceState, Dict[str, Any]]:
     """Restore params / BN state / inner hyperparams / step for serving,
     dropping the outer optimizer state (serving never takes an outer step;
     note this also means an inner-Adam config with
@@ -125,9 +235,7 @@ def load_for_inference(save_dir: str, idx) -> Tuple[InferenceState, Dict[str, An
     Unlike :func:`load_checkpoint` this needs no template state: the flax
     msgpack payload stores the TrainState by field name with plain
     dict-of-ndarray subtrees, which restore structurally as-is."""
-    with open(_path(save_dir, idx), "rb") as f:
-        blob = f.read()
-    payload = serialization.msgpack_restore(blob)
+    payload, blob = _read_payload(_path(save_dir, idx), injector)
     # "network" is itself msgpack bytes (see _serialize): decode the inner
     # layer to the field-name-keyed TrainState dict
     net = serialization.msgpack_restore(payload["network"])

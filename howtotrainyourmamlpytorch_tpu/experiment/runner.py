@@ -14,11 +14,15 @@ step outputs are fetched asynchronously (XLA dispatch overlaps the host-side
 episode assembly).
 """
 
+import contextlib
 import os
+import signal
+import threading
 import time
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config, save_config
@@ -32,6 +36,7 @@ from ..parallel import (
     make_mesh,
     shard_train_state,
 )
+from ..resilience.faults import injector_from
 from ..utils.trees import named_leaves
 from . import checkpoint as ckpt
 from . import storage
@@ -80,6 +85,16 @@ class ExperimentRunner:
         self.experiment_name = cfg.run_name()
         storage.create_json_experiment_log(self.logs_dir, self.experiment_name, cfg.to_dict())
 
+        # --- resilience (config.py::ResilienceConfig; resilience/ package) ---
+        # fault injector (inert unless cfg.resilience.faults / HTYMP_FAULTS
+        # name a drill), NaN-ladder counters, preemption flag
+        self._injector = injector_from(cfg.resilience)
+        self._bad_steps = 0  # consecutive non-finite steps discarded
+        self._rollbacks = 0  # rollbacks spent (rc=3 after max_rollbacks more)
+        self._last_good = None  # host-side TrainState copy for rollback
+        self._preempt_signum: Optional[int] = None
+        self._resume_mid_iter = 0  # >0: start_epoch was preempted mid-epoch
+
         # --- resume (reference continue_from_epoch: latest, config.yaml:51) ---
         self.state: TrainState = self.system.init_train_state()
         self.start_epoch = 0
@@ -103,10 +118,23 @@ class ExperimentRunner:
                 )
             resumable = False
         if resumable:
-            self.state, bookkeeping = ckpt.load_checkpoint(
-                self.saved_models_dir, idx, self.state
-            )
+            if idx == "latest":
+                # integrity chain: a corrupt 'latest' (torn write at the
+                # moment of a kill) is quarantined and the newest valid
+                # epoch file resumes instead of crashing the run
+                self.state, bookkeeping, used_idx = ckpt.load_latest_with_fallback(
+                    self.saved_models_dir, self.state, self._injector
+                )
+            else:
+                self.state, bookkeeping = ckpt.load_checkpoint(
+                    self.saved_models_dir, idx, self.state, self._injector
+                )
+                used_idx = idx
             self.start_epoch = int(bookkeeping.get("epoch", -1)) + 1
+            # a preemption checkpoint carries the mid-epoch iteration cursor:
+            # start_epoch is then the *interrupted* epoch, resumed at
+            # exactly the next iteration (the loader cursor below matches)
+            self._resume_mid_iter = int(bookkeeping.get("mid_epoch_iter", 0) or 0)
             self.best_val_accuracy = float(bookkeeping.get("best_val_accuracy", -1.0))
             self.best_val_epoch = int(bookkeeping.get("best_val_epoch", -1))
             self.val_acc_by_epoch = {
@@ -114,7 +142,10 @@ class ExperimentRunner:
                 for k, v in (bookkeeping.get("val_acc_by_epoch") or {}).items()
             }
             storage.change_json_log_experiment_status(
-                self.logs_dir, self.experiment_name, f"resumed at epoch {self.start_epoch}"
+                self.logs_dir, self.experiment_name,
+                f"resumed at epoch {self.start_epoch}"
+                + (f" iter {self._resume_mid_iter}" if self._resume_mid_iter else "")
+                + (f" (from {used_idx})" if used_idx != idx else ""),
             )
 
         # --- mesh / sharding (no-op on one device) ---
@@ -170,10 +201,17 @@ class ExperimentRunner:
         self._owns_loader = loader is None
         self.loader = loader or MetaLearningDataLoader(
             cfg,
-            current_iter=self.start_epoch * cfg.total_iter_per_epoch,
+            # mid-epoch resume (preemption checkpoint): the stream cursor
+            # restarts on the exact next iteration, not the epoch boundary
+            current_iter=self.start_epoch * cfg.total_iter_per_epoch
+            + self._resume_mid_iter,
             data_root=data_root,
             host_shard=host_shard,
+            injector=self._injector,
         )
+        # rollback anchor: the state as placed on device(s) right now — the
+        # resumed checkpoint, or init. Refreshed on every epoch save.
+        self._capture_last_good()
 
     # ------------------------------------------------------------------
 
@@ -187,53 +225,126 @@ class ExperimentRunner:
 
     def _train_epoch(self, epoch: int) -> Dict[str, Any]:
         cfg = self.cfg
+        res = cfg.resilience
         losses, accs, lr = [], [], 0.0
         start = time.time()
+        # mid-epoch resume (preemption checkpoint): run only the remaining
+        # iterations of the interrupted epoch — the loader cursor already
+        # points at the exact next iteration
+        skipped = self._resume_mid_iter if epoch == self.start_epoch else 0
+        total_iters = cfg.total_iter_per_epoch - skipped
         # profiling window (SURVEY.md §5.1): trace iters [10, 20) of the first
         # trained epoch — past compile/warmup, short enough to inspect
         profile_this_epoch = bool(cfg.profile_dir) and not self._profiled
-        prof_start, prof_stop = (10, 20) if cfg.total_iter_per_epoch >= 20 else (0, 1)
+        prof_start, prof_stop = (10, 20) if total_iters >= 20 else (0, 1)
         # multi-step dispatch (train_steps_per_dispatch=K): scan K outer
         # steps per device call. The profiled epoch keeps K=1 so the trace
         # window stays per-iter.
         K = 1 if profile_this_epoch else max(1, cfg.train_steps_per_dispatch)
-        n_chunks, single_iters = divmod(cfg.total_iter_per_epoch, K)
+        n_chunks, single_iters = divmod(total_iters, K)
+
+        # --- NaN sentinel (resilience.nan_guard) -----------------------
+        # Each dispatch's scalar loss is checked host-side with a ONE-
+        # dispatch lag: while dispatch i executes on device, dispatch i-1's
+        # loss is fetched and judged, so one call stays in flight and
+        # episode assembly still overlaps compute. A non-finite loss
+        # discards the poisoned step (and the in-flight step built on it)
+        # by restoring the state captured before it; the episode stream
+        # moves on past the bad batch.
+        guard = res.nan_guard
+        pending = None  # (state_before, loss_dev, acc_dev, forced_nan)
+
+        def settle() -> bool:
+            """Judge the pending dispatch; True = good (stats recorded)."""
+            nonlocal pending
+            state_before, loss_dev, acc_dev, forced = pending
+            pending = None
+            loss_host = np.atleast_1d(np.asarray(jax.device_get(loss_dev)))
+            if forced or not np.all(np.isfinite(loss_host)):
+                self.state = state_before
+                return False
+            losses.append(loss_host)
+            accs.append(np.atleast_1d(np.asarray(jax.device_get(acc_dev))))
+            return True
+
+        preempted = False
+        undispatched_iters = 0  # yielded by the loader but never dispatched
         if K > 1:
             for chunk in self.loader.train_batch_chunks(
                 n_chunks, K, augment_images=True
             ):
+                if self._preempt_signum is not None:
+                    preempted = True
+                    undispatched_iters = K
+                    break
+                forced = self._injector.fire("runner.step") == "nan-loss"
                 put = self._put(
                     chunk,
                     self._chunk_sharding if self.mesh is not None else None,
                 )
+                before = self.state
                 self.state, (chunk_losses, chunk_accs, chunk_lrs) = (
                     self.system.train_step_multi(self.state, put, epoch)
                 )
-                losses.append(chunk_losses)
-                accs.append(chunk_accs)
                 lr = chunk_lrs[-1]
+                if not guard:
+                    losses.append(chunk_losses)
+                    accs.append(chunk_accs)
+                    continue
+                if pending is not None and not settle():
+                    # settle() restored the pre-poison state, which also
+                    # discards the dispatch we just issued on top of it
+                    self._note_bad_step(epoch)
+                    continue
+                pending = (before, chunk_losses, chunk_accs, forced)
         else:
-            single_iters = cfg.total_iter_per_epoch
-        for it, batch in enumerate(
-            self.loader.train_batches(single_iters, augment_images=True)
-        ):
-            if profile_this_epoch and it == prof_start:
-                jax.profiler.start_trace(cfg.profile_dir)
-            # epoch passed host-side: program-variant selection without a
-            # device sync, so step dispatch overlaps episode assembly
-            self.state, out = self.system.train_step(self.state, self._put(batch), epoch=epoch)
-            if profile_this_epoch and it == prof_stop - 1:
-                out.loss.block_until_ready()
-                jax.profiler.stop_trace()
-                self._profiled = True
-            losses.append(out.loss)
-            accs.append(out.accuracy)
-            lr = out.learning_rate
+            single_iters = total_iters
+        if not preempted:
+            for it, batch in enumerate(
+                self.loader.train_batches(single_iters, augment_images=True)
+            ):
+                if self._preempt_signum is not None:
+                    preempted = True
+                    undispatched_iters = 1
+                    break
+                if profile_this_epoch and it == prof_start:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                forced = self._injector.fire("runner.step") == "nan-loss"
+                before = self.state
+                # epoch passed host-side: program-variant selection without a
+                # device sync, so step dispatch overlaps episode assembly
+                self.state, out = self.system.train_step(
+                    self.state, self._put(batch), epoch=epoch
+                )
+                if profile_this_epoch and it == prof_stop - 1:
+                    out.loss.block_until_ready()
+                    jax.profiler.stop_trace()
+                    self._profiled = True
+                lr = out.learning_rate
+                if not guard:
+                    losses.append(out.loss)
+                    accs.append(out.accuracy)
+                    continue
+                if pending is not None and not settle():
+                    self._note_bad_step(epoch)
+                    continue
+                pending = (before, out.loss, out.accuracy, forced)
+        # drain the lagged check (also before an emergency save: the saved
+        # state must be a settled-good one)
+        if pending is not None and not settle():
+            self._note_bad_step(epoch)
+        if preempted or self._preempt_signum is not None:
+            self._emergency_exit(epoch, undispatched=undispatched_iters)
         # one bulk fetch instead of 2*iters scalar device_gets (each a
-        # round-trip when the chip sits behind a network tunnel)
+        # round-trip when the chip sits behind a network tunnel); with the
+        # guard on, entries are already host arrays and this is a no-op
         losses, accs = jax.device_get((losses, accs))
-        losses = np.concatenate([np.atleast_1d(x) for x in losses])
-        accs = np.concatenate([np.atleast_1d(x) for x in accs])
+        losses = np.concatenate([np.atleast_1d(x) for x in losses] or [np.zeros(0)])
+        accs = np.concatenate([np.atleast_1d(x) for x in accs] or [np.zeros(0)])
+        if losses.size == 0:
+            # every step of the epoch was discarded as non-finite: nothing
+            # to aggregate; report NaN rather than crashing on empty mean
+            losses = accs = np.asarray([np.nan])
         loss_mean, loss_std = _mean_std(losses)
         acc_mean, acc_std = _mean_std(accs)
         return {
@@ -244,6 +355,155 @@ class ExperimentRunner:
             "learning_rate": float(lr),
             "epoch_run_time": time.time() - start,
         }
+
+    # ------------------------------------------------------------------
+    # resilience: NaN skip/rollback ladder + preemption (resilience/)
+    # ------------------------------------------------------------------
+
+    def _place_state(self, host_state: TrainState) -> TrainState:
+        """Host pytree -> device state with the run's shardings."""
+        if self.mesh is not None:
+            return shard_train_state(
+                host_state, self.mesh, tp_convs=self.cfg.parallel.tp_convs
+            )
+        return jax.tree.map(jnp.asarray, host_state)
+
+    def _capture_last_good(self) -> None:
+        self._last_good = jax.device_get(self.state)
+
+    def _note_bad_step(self, epoch: int) -> None:
+        """One discarded non-finite step. The ladder: after
+        ``max_consecutive_bad_steps`` (K) discards, roll the TrainState back
+        to the last good checkpointed state with an outer-LR backoff; after
+        ``max_rollbacks`` (M) rollbacks have already been spent, give up with
+        the permanent exit code 3 (scripts/sweep.sh: diverged, don't
+        restart). The episode cursor is NOT rewound — replaying the same
+        stream into the same state would reproduce the same NaN."""
+        res = self.cfg.resilience
+        self._bad_steps += 1
+        storage.append_jsonl(
+            self.logs_dir,
+            {
+                "ts": time.time(),
+                "event": "nan_step_skipped",
+                "epoch": epoch,
+                "consecutive": self._bad_steps,
+            },
+        )
+        print(
+            f"warning: non-finite step loss at epoch {epoch} — step discarded "
+            f"({self._bad_steps}/{res.max_consecutive_bad_steps} consecutive)",
+            flush=True,
+        )
+        if self._bad_steps < res.max_consecutive_bad_steps:
+            return
+        if self._rollbacks >= res.max_rollbacks:
+            msg = (
+                f"NAN ABORT: {self._bad_steps} consecutive non-finite steps "
+                f"after {self._rollbacks} rollbacks — unrecoverable"
+            )
+            print(msg, flush=True)
+            storage.append_jsonl(
+                self.logs_dir, {"ts": time.time(), "event": "nan_abort", "epoch": epoch}
+            )
+            storage.change_json_log_experiment_status(
+                self.logs_dir, self.experiment_name, msg
+            )
+            raise SystemExit(3)
+        self._rollbacks += 1
+        self._bad_steps = 0
+        self.state = self._place_state(self._last_good)
+        self.system.scale_meta_lr(res.rollback_lr_backoff)
+        storage.append_jsonl(
+            self.logs_dir,
+            {
+                "ts": time.time(),
+                "event": "nan_rollback",
+                "epoch": epoch,
+                "rollback": self._rollbacks,
+                "meta_lr_scale": self.system.meta_lr_scale,
+            },
+        )
+        print(
+            f"warning: rolled back to last good state (rollback "
+            f"{self._rollbacks}/{self.cfg.resilience.max_rollbacks}, outer LR "
+            f"x{self.system.meta_lr_scale:g})",
+            flush=True,
+        )
+
+    def _handle_preempt_signal(self, signum, frame) -> None:
+        # signal-safe: just flag; the train loop saves at the next step
+        # boundary and exits (a second signal still only sets the flag —
+        # the emergency save itself is an atomic tmp+rename)
+        self._preempt_signum = signum
+
+    @contextlib.contextmanager
+    def _preemption_guard(self):
+        """Install SIGTERM/SIGINT -> emergency-checkpoint handlers for the
+        duration of run_experiment (main thread only — signal.signal is a
+        main-thread API; runners driven from worker threads, e.g. tests,
+        keep default handling)."""
+        if (
+            not self.cfg.resilience.preemption_save
+            or threading.current_thread() is not threading.main_thread()
+        ):
+            yield
+            return
+        prev = {
+            s: signal.signal(s, self._handle_preempt_signal)
+            for s in (signal.SIGTERM, signal.SIGINT)
+        }
+        try:
+            yield
+        finally:
+            for s, handler in prev.items():
+                signal.signal(s, handler)
+
+    def _emergency_exit(self, epoch: int, undispatched: int) -> None:
+        """Preemption mid-epoch: write an emergency 'latest' checkpoint whose
+        bookkeeping carries the mid-epoch iteration cursor (matching the
+        loader's exact-resume cursor), then exit with the distinct
+        restart-not-fail code (sweep.sh treats it as a free restart).
+        ``undispatched``: batches already drawn from the loader but never
+        dispatched (they will be re-drawn on resume)."""
+        cfg = self.cfg
+        consumed = (
+            self.loader.train_episodes_produced // self.loader.batch_size
+            - epoch * cfg.total_iter_per_epoch
+        )
+        mid = consumed - undispatched
+        bookkeeping = {
+            "epoch": epoch - 1,  # last fully completed epoch
+            "mid_epoch_iter": mid,
+            "train_episodes_produced": (
+                (epoch * cfg.total_iter_per_epoch + mid) * self.loader.batch_size
+            ),
+            "best_val_accuracy": self.best_val_accuracy,
+            "best_val_epoch": self.best_val_epoch,
+            "val_acc_by_epoch": {str(k): v for k, v in self.val_acc_by_epoch.items()},
+        }
+        ckpt.save_named(
+            self.saved_models_dir,
+            jax.device_get(self.state),
+            bookkeeping,
+            "latest",
+            injector=self._injector,
+        )
+        signame = signal.Signals(self._preempt_signum).name
+        msg = (
+            f"PREEMPTED ({signame}) at epoch {epoch} iter {mid}: emergency "
+            f"checkpoint written, exiting "
+            f"{cfg.resilience.preemption_exit_code} (restart to resume)"
+        )
+        print(msg, flush=True)
+        storage.append_jsonl(
+            self.logs_dir,
+            {"ts": time.time(), "event": "preempted", "epoch": epoch, "iter": mid},
+        )
+        storage.change_json_log_experiment_status(
+            self.logs_dir, self.experiment_name, msg
+        )
+        raise SystemExit(cfg.resilience.preemption_exit_code)
 
     def _eval_split(self, split: str) -> Dict[str, Any]:
         cfg = self.cfg
@@ -312,9 +572,10 @@ class ExperimentRunner:
             "train_episodes_produced": self.loader.train_episodes_produced,
             "val_acc_by_epoch": {str(k): v for k, v in self.val_acc_by_epoch.items()},
         }
+        host_state = jax.device_get(self.state)
         ckpt.save_checkpoint(
             self.saved_models_dir,
-            jax.device_get(self.state),
+            host_state,
             bookkeeping,
             epoch,
             self.cfg.max_models_to_save,
@@ -323,7 +584,10 @@ class ExperimentRunner:
                 if self.cfg.checkpoint_rotation == "best_val"
                 else None
             ),
+            injector=self._injector,
         )
+        # this durable state is the new NaN-rollback anchor
+        self._last_good = host_state
 
     def _save_best(self) -> None:
         ckpt.save_named(
@@ -433,10 +697,13 @@ class ExperimentRunner:
     def run_experiment(self) -> Dict[str, Any]:
         """Train/eval to completion. An owned loader is shut down on EVERY
         exit path — normal completion, the SystemExit(3) early-divergence
-        abort, and errors — so back-to-back runs in one process (sweeps,
-        tests) don't accumulate leaked episode-pool threads."""
+        abort, the preemption SystemExit, and errors — so back-to-back runs
+        in one process (sweeps, tests) don't accumulate leaked episode-pool
+        threads. SIGTERM/SIGINT during the run trigger the emergency-save
+        path (resilience.preemption_save)."""
         try:
-            return self._run_experiment()
+            with self._preemption_guard():
+                return self._run_experiment()
         finally:
             if self._owns_loader:
                 self.loader.close()
@@ -464,6 +731,22 @@ class ExperimentRunner:
                 self.best_val_epoch = epoch
                 self._save_best()
             self._save(epoch)
+            # a preemption signal that landed during eval/save: the epoch
+            # checkpoint just written is complete, so exit restartable
+            # without an extra emergency save
+            if self._preempt_signum is not None:
+                signame = signal.Signals(self._preempt_signum).name
+                code = cfg.resilience.preemption_exit_code
+                print(
+                    f"PREEMPTED ({signame}) after epoch {epoch}: checkpoint "
+                    f"already written, exiting {code} (restart to resume)",
+                    flush=True,
+                )
+                storage.append_jsonl(
+                    self.logs_dir,
+                    {"ts": time.time(), "event": "preempted", "epoch": epoch},
+                )
+                raise SystemExit(code)
             print(
                 f"epoch {epoch}: train_acc={stats['train_accuracy_mean']:.4f} "
                 f"val_acc={stats['val_accuracy_mean']:.4f} "

@@ -64,13 +64,16 @@ def replicate(tree, mesh: Mesh):
 
 
 def _param_spec(shape, mp: int, tp_convs: bool = False, leaf_name=None) -> P:
-    """Tensor-parallel spec for one parameter leaf: *dense (2-D) kernels*
-    shard their output-features axis (column-parallel ``P(None, 'mp')``)
-    when it divides ``mp``; with ``tp_convs`` HWIO conv kernels — 4-D
-    leaves named ``'w'``, the layer-zoo kernel convention (ADVICE r4: keyed
-    off the name so a future 4-D non-kernel parameter is not silently
-    mp-sharded) — shard their output-channel axis the same way; everything
-    else is replicated.
+    """Tensor-parallel spec for one parameter leaf: *dense (2-D) kernels* —
+    leaves named ``'w'``, the layer-zoo kernel convention — shard their
+    output-features axis (column-parallel ``P(None, 'mp')``) when it divides
+    ``mp``; with ``tp_convs`` HWIO conv kernels (4-D ``'w'`` leaves) shard
+    their output-channel axis the same way; everything else is replicated.
+    Both branches key off the name, not shape alone (ADVICE r4 for the 4-D
+    branch, ADVICE r5 #1 for the 2-D one): a future 2-D non-kernel parameter
+    — a learned per-(step, tensor) hparam table, a class-embedding matrix
+    whose trailing axis happens to divide mp — must not be silently
+    mp-sharded by its shape.
 
     Why exactly this layout (verified on the 8-device CPU mesh):
     - on the NATIVE conv path, conv-kernel channel sharding is rejected by
@@ -96,7 +99,12 @@ def _param_spec(shape, mp: int, tp_convs: bool = False, leaf_name=None) -> P:
     The conv kernels here are <=150KB, so conv TP buys memory/FLOP spread
     only as backbones widen; the machinery is exercised end-to-end either
     way (tests/test_parallel.py, __graft_entry__.dryrun_multichip)."""
-    if len(shape) == 2 and shape[1] >= mp and shape[1] % mp == 0:
+    if (
+        leaf_name == "w"
+        and len(shape) == 2
+        and shape[1] >= mp
+        and shape[1] % mp == 0
+    ):
         return P(None, MODEL_AXIS)
     if (
         tp_convs

@@ -1,0 +1,33 @@
+"""Resilience: fault injection, retry/backoff, circuit breaking.
+
+The training contract (SURVEY §5.3-§5.4) promises failure detection,
+pause/recovery, and exact resume; the serving layer promises bounded latency
+under load. This package is the shared machinery that makes both promises
+*testable* rather than aspirational:
+
+- :mod:`faults` — ``FaultInjector``: a config/env-driven registry of
+  deterministic, seeded injection points at the real seams (checkpoint
+  read/write, episode assembly, step dispatch, HTTP handler). Off by
+  default; inert and bit-identical to an unpatched build when disabled.
+- :mod:`retry` — ``retry_call``: exponential backoff + jitter with an
+  injectable clock/sleep (loader transient-I/O retries, client helpers).
+- :mod:`breaker` — ``CircuitBreaker``: closed/open/half-open around the
+  serving engine's device dispatch.
+
+Consumers of the *policies* (NaN-step skip/rollback ladder, preemption-safe
+emergency checkpoints, checkpoint integrity + fallback, load shedding) live
+where the state lives: ``experiment/runner.py``, ``experiment/checkpoint.py``,
+``data/loader.py``, ``serving/``. Knobs: ``Config.resilience``
+(``config.py::ResilienceConfig``); drills: ``docs/OPERATIONS.md``.
+"""
+
+from .breaker import CircuitBreaker  # noqa: F401
+from .faults import (  # noqa: F401
+    ENV_VAR,
+    NULL_INJECTOR,
+    FaultInjector,
+    FaultSpec,
+    InjectedFault,
+    injector_from,
+)
+from .retry import DeadlineExceededError, backoff_schedule, retry_call  # noqa: F401

@@ -1,0 +1,145 @@
+"""Circuit breaker for the serving engine's device dispatch.
+
+When the device path starts failing repeatedly (wedged tunnel, poisoned
+compile cache, OOM loop), every queued request burns a full dispatch attempt
+and a deadline before failing — the breaker converts that into an immediate,
+cheap 503 the client can back off on, and probes the device again after a
+cooldown.
+
+States (classic three-state breaker):
+
+- ``closed``: all calls pass; ``failure_threshold`` *consecutive* failures
+  trip it open.
+- ``open``: calls are rejected without dispatching; after ``cooldown_s``
+  (measured on the injectable clock) the next ``allow()`` moves to half-open.
+- ``half_open``: up to ``half_open_probes`` calls pass as probes. Any probe
+  failure re-opens (fresh cooldown); once ``half_open_probes`` probes succeed
+  the breaker closes.
+
+Thread-safe; the clock is injectable so tests walk the whole state machine
+with zero real waiting.
+"""
+
+import threading
+import time
+from typing import Any, Callable, Dict
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        cooldown_s: float = 10.0,
+        half_open_probes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError(f"failure_threshold must be >= 1, got {failure_threshold}")
+        if half_open_probes < 1:
+            raise ValueError(f"half_open_probes must be >= 1, got {half_open_probes}")
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.half_open_probes = int(half_open_probes)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probes_allowed = 0
+        self._probes_succeeded = 0
+        # lifetime counters for /metrics
+        self.opens = 0
+        self.rejections = 0
+        self.failures = 0
+        self.successes = 0
+
+    # ------------------------------------------------------------------
+
+    def _trip_locked(self) -> None:
+        self._state = OPEN
+        self._opened_at = self._clock()
+        self._consecutive_failures = 0
+        self._probes_allowed = 0
+        self._probes_succeeded = 0
+        self.opens += 1
+
+    def allow(self) -> bool:
+        """May a call proceed right now? Rejections are counted. A True from
+        half-open consumes one probe slot — the caller MUST follow up with
+        ``record_success``/``record_failure``."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock() - self._opened_at >= self.cooldown_s:
+                    self._state = HALF_OPEN
+                    self._probes_allowed = 0
+                    self._probes_succeeded = 0
+                else:
+                    self.rejections += 1
+                    return False
+            # half-open: bounded probe slots
+            if self._probes_allowed < self.half_open_probes:
+                self._probes_allowed += 1
+                return True
+            self.rejections += 1
+            return False
+
+    def release_probe(self) -> None:
+        """Give back a half-open probe slot whose call never produced a
+        verdict (shed before dispatch, or timed out with the outcome
+        unknown). Without this, an unresolved probe would permanently consume
+        the slot and wedge the breaker in half_open — rejecting all traffic
+        forever even after the device recovers."""
+        with self._lock:
+            if self._state == HALF_OPEN and self._probes_allowed > 0:
+                self._probes_allowed -= 1
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.successes += 1
+            if self._state == HALF_OPEN:
+                self._probes_succeeded += 1
+                if self._probes_succeeded >= self.half_open_probes:
+                    self._state = CLOSED
+                    self._consecutive_failures = 0
+            else:
+                self._consecutive_failures = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+            if self._state == HALF_OPEN:
+                self._trip_locked()  # a failed probe re-opens with fresh cooldown
+                return
+            self._consecutive_failures += 1
+            if self._state == CLOSED and self._consecutive_failures >= self.failure_threshold:
+                self._trip_locked()
+
+    # ------------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # surface the lazily-entered half-open so /healthz reads right
+            # even before the first post-cooldown call arrives
+            if (
+                self._state == OPEN
+                and self._clock() - self._opened_at >= self.cooldown_s
+            ):
+                return HALF_OPEN
+            return self._state
+
+    def snapshot(self) -> Dict[str, Any]:
+        state = self.state
+        with self._lock:
+            return {
+                "state": state,
+                "opens": self.opens,
+                "rejections": self.rejections,
+                "failures": self.failures,
+                "successes": self.successes,
+                "consecutive_failures": self._consecutive_failures,
+            }

@@ -18,11 +18,12 @@ server:
   ``ThreadingHTTPServer`` JSON front-end (``scripts/serve.py``).
 """
 
-from .batcher import MicroBatcher  # noqa: F401
+from .batcher import MicroBatcher, QueueFullError  # noqa: F401
 from .cache import AdaptedWeightCache, support_digest, tree_bytes  # noqa: F401
 from .engine import AdaptationEngine  # noqa: F401
-from .metrics import LatencyStats  # noqa: F401
+from .metrics import EventCounters, LatencyStats  # noqa: F401
 from .server import (  # noqa: F401
+    ServiceUnavailableError,
     ServingFrontend,
     UnknownAdaptationError,
     frontend_from_run_dir,

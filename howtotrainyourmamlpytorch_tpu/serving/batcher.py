@@ -13,15 +13,24 @@ interleaved transfers.
 import threading
 import time
 from collections import OrderedDict
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Dict, Hashable, List, Tuple
+
+
+class QueueFullError(RuntimeError):
+    """submit() refused: the batcher already holds ``max_queue_depth``
+    undispatched requests. Load-shedding at the queue (rather than letting it
+    grow unboundedly and time every request out) keeps tail latency bounded
+    under overload; the HTTP layer maps this to 503 + ``Retry-After``."""
 
 
 class MicroBatcher:
     """Groups submitted payloads by bucket key and flushes each group through
     ``flush_fn(bucket_key, payloads) -> results`` (one result per payload, in
     order). ``submit`` returns a ``Future``; a ``flush_fn`` exception fails
-    every future of its group."""
+    every future of its group. ``max_queue_depth`` (None = unbounded, the
+    pre-resilience behavior) sheds submits beyond that many queued requests
+    with :class:`QueueFullError`, counted in ``stats()['shed']``."""
 
     def __init__(
         self,
@@ -29,12 +38,14 @@ class MicroBatcher:
         max_batch: int,
         deadline_ms: float,
         name: str = "batcher",
+        max_queue_depth: int = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self._flush_fn = flush_fn
         self.max_batch = int(max_batch)
         self.deadline_s = float(deadline_ms) / 1000.0
+        self.max_queue_depth = None if max_queue_depth is None else int(max_queue_depth)
         self.name = name
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -44,6 +55,7 @@ class MicroBatcher:
         self._groups: "OrderedDict[Hashable, List[Tuple[Any, Future, float]]]" = OrderedDict()
         self._closed = False
         self.requests = 0
+        self.shed = 0  # submits refused at max_queue_depth
         self.flushes_full = 0
         self.flushes_deadline = 0
         self.batched_requests = 0  # requests that shared a flush with others
@@ -59,6 +71,17 @@ class MicroBatcher:
         with self._wake:
             if self._closed:
                 raise RuntimeError(f"{self.name} is closed")
+            if (
+                self.max_queue_depth is not None
+                and sum(len(g) for g in self._groups.values()) >= self.max_queue_depth
+            ):
+                # shed under the same lock the depth is read under — no race
+                # between the check and the enqueue
+                self.shed += 1
+                raise QueueFullError(
+                    f"{self.name} queue full ({self.max_queue_depth} requests "
+                    "undispatched) — shedding"
+                )
             self._groups.setdefault(bucket_key, []).append(
                 (payload, fut, time.monotonic())
             )
@@ -75,6 +98,7 @@ class MicroBatcher:
             flushes = self.flushes_full + self.flushes_deadline
             return {
                 "requests": self.requests,
+                "shed": self.shed,
                 "flushes": flushes,
                 "flushes_full": self.flushes_full,
                 "flushes_deadline": self.flushes_deadline,
@@ -145,6 +169,13 @@ class MicroBatcher:
                 if len(ready[1]) > 1:
                     self.batched_requests += len(ready[1])
             key, group = ready
+            # a future cancelled while queued (request-deadline shed,
+            # serving/server.py::_dispatch) must not consume device work —
+            # and completing it would raise InvalidStateError and kill this
+            # worker thread
+            group = [(p, fut, t) for p, fut, t in group if not fut.cancelled()]
+            if not group:
+                continue
             payloads = [p for p, _, _ in group]
             try:
                 results = self._flush_fn(key, payloads)
@@ -155,7 +186,19 @@ class MicroBatcher:
                     )
             except BaseException as exc:  # noqa: BLE001 — fail the futures, keep serving
                 for _, fut, _ in group:
-                    fut.set_exception(exc)
+                    self._complete(fut, exc=exc)
                 continue
             for (_, fut, _), res in zip(group, results):
-                fut.set_result(res)
+                self._complete(fut, result=res)
+
+    @staticmethod
+    def _complete(fut: Future, result=None, exc=None) -> None:
+        """Set a future's outcome, tolerating a cancel that raced the flush
+        (the caller already gave up on it; the worker must survive)."""
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+        except InvalidStateError:
+            pass

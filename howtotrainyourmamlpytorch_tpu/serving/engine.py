@@ -27,6 +27,7 @@ import numpy as np
 from ..config import Config, ServingConfig, load_config
 from ..core import MAMLSystem, TrainState
 from ..experiment import checkpoint as ckpt
+from ..resilience.faults import injector_from
 
 
 def _bucket_for(size: int, buckets: Sequence[int]) -> int:
@@ -66,10 +67,20 @@ class AdaptationEngine:
         state,
         serving_cfg: Optional[ServingConfig] = None,
         fingerprint: Optional[str] = None,
+        injector=None,
     ):
         self.system = system
         self.cfg = system.cfg
         self.serving = serving_cfg or self.cfg.serving
+        # fault seam 'serving.dispatch' fires at the head of every batched
+        # device dispatch — the drill lever for the frontend's circuit
+        # breaker (resilience/breaker.py). Default: built from the run
+        # config's resilience block + the HTYMP_FAULTS env var, so the
+        # OPERATIONS.md serving drills work through every construction path
+        # (scripts/serve.py, from_run_dir, direct) without plumbing.
+        self.injector = (
+            injector if injector is not None else injector_from(self.cfg.resilience)
+        )
         if isinstance(state, ckpt.InferenceState):
             fingerprint = fingerprint or state.fingerprint
             state = TrainState(
@@ -192,6 +203,7 @@ class AdaptationEngine:
         ``items`` is a list of ``(x_support, y_support)``; returns one
         adapted-parameter pytree per item (device arrays, stackable into the
         cache)."""
+        self.injector.fire("serving.dispatch")
         flat = [self._flatten_support(x, y) for x, y in items]
         sizes = {x.shape[0] for x, _ in flat}
         bucket = self.support_bucket(max(sizes))
@@ -220,6 +232,7 @@ class AdaptationEngine:
         adapted weights, in one device dispatch. ``items`` is a list of
         ``(fast_weights, x_query)``; returns per-item softmax probabilities
         [Q_i, num_classes] as host arrays, padding sliced off."""
+        self.injector.fire("serving.dispatch")
         queries = [np.asarray(x, np.float32) for _, x in items]
         sizes = [q.shape[0] for q in queries]
         bucket = self.query_bucket(max(sizes))

@@ -53,6 +53,28 @@ class LatencyStats:
             return out
 
 
+class EventCounters:
+    """Thread-safe named counters for the resilience surface (shed requests,
+    deadline misses, breaker rejections, dispatch failures) — the numbers the
+    OPERATIONS.md degraded-modes runbook reads off ``/metrics``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
 class _Timer:
     def __init__(self, stats: LatencyStats, phase: str):
         self._stats = stats

@@ -19,6 +19,7 @@ threaded server (one thread per in-flight request) feeding the batchers,
 whose single worker serializes device dispatch.
 """
 
+import concurrent.futures
 import json
 import os
 import threading
@@ -29,37 +30,66 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-from ..config import Config, ServingConfig
-from ..core import MAMLSystem
-from .batcher import MicroBatcher
+from ..config import Config, ResilienceConfig, ServingConfig
+from ..resilience.breaker import CircuitBreaker
+from ..resilience.retry import DeadlineExceededError
+from .batcher import MicroBatcher, QueueFullError
 from .cache import AdaptedWeightCache, support_digest
 from .engine import AdaptationEngine
-from .metrics import LatencyStats
+from .metrics import EventCounters, LatencyStats
 
 
 class UnknownAdaptationError(KeyError):
     """predict() named an adaptation id that is not (or no longer) cached."""
 
 
+class ServiceUnavailableError(RuntimeError):
+    """The frontend refused the request without dispatching it — queue full
+    (load shed) or circuit breaker open. The HTTP layer maps this to 503 with
+    a ``Retry-After`` header so clients back off instead of hammering."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 class ServingFrontend:
-    def __init__(self, engine: AdaptationEngine, serving_cfg: Optional[ServingConfig] = None):
+    def __init__(
+        self,
+        engine: AdaptationEngine,
+        serving_cfg: Optional[ServingConfig] = None,
+        resilience_cfg: Optional[ResilienceConfig] = None,
+        clock=time.monotonic,
+    ):
         self.engine = engine
         self.serving = serving_cfg or engine.serving
+        # resilience knobs ride the run config like the serving knobs do;
+        # clock is injectable so breaker tests walk cooldowns without waiting
+        self.resilience = resilience_cfg or engine.cfg.resilience
         self.cache = AdaptedWeightCache(
             max_bytes=self.serving.cache_max_bytes, ttl_s=self.serving.cache_ttl_s
         )
         self.latency = LatencyStats(self.serving.latency_window)
+        self.counters = EventCounters()
+        self.breaker = CircuitBreaker(
+            failure_threshold=self.resilience.breaker_failure_threshold,
+            cooldown_s=self.resilience.breaker_cooldown_s,
+            half_open_probes=self.resilience.breaker_half_open_probes,
+            clock=clock,
+        )
         self._adapt_batcher = MicroBatcher(
             lambda bucket, payloads: self.engine.adapt_batch(payloads),
             max_batch=self.serving.max_batch_size,
             deadline_ms=self.serving.batch_deadline_ms,
             name="adapt",
+            max_queue_depth=self.resilience.max_queue_depth,
         )
         self._predict_batcher = MicroBatcher(
             lambda bucket, payloads: self.engine.predict_batch(payloads),
             max_batch=self.serving.max_batch_size,
             deadline_ms=self.serving.batch_deadline_ms,
             name="predict",
+            max_queue_depth=self.resilience.max_queue_depth,
         )
         self._started = time.monotonic()
         self._closed = False
@@ -69,6 +99,50 @@ class ServingFrontend:
     def _cache_key(self, digest: str) -> Tuple[str, str]:
         return (self.engine.fingerprint, digest)
 
+    def _dispatch(self, batcher: MicroBatcher, bucket, payload):
+        """One guarded device dispatch: circuit breaker (fail fast while the
+        device path is known-bad), queue-depth shed (bounded tail latency),
+        per-request deadline (no caller waits forever on a wedged device).
+        Dispatch failures/successes feed the breaker; client-side refusals
+        (shed, breaker-open, deadline) deliberately do not — they say nothing
+        about device health."""
+        res = self.resilience
+        if not self.breaker.allow():
+            self.counters.inc("breaker_rejected")
+            raise ServiceUnavailableError(
+                f"engine circuit breaker {self.breaker.state}; retry after "
+                f"cooldown",
+                retry_after_s=res.breaker_cooldown_s,
+            )
+        try:
+            fut = batcher.submit(bucket, payload)
+        except QueueFullError as exc:
+            # never dispatched: a half-open probe slot this call consumed
+            # must be returned or the breaker wedges in half_open
+            self.breaker.release_probe()
+            self.counters.inc("shed")
+            raise ServiceUnavailableError(
+                str(exc), retry_after_s=res.shed_retry_after_s
+            ) from exc
+        try:
+            result = fut.result(timeout=res.request_deadline_s)
+        except concurrent.futures.TimeoutError as exc:
+            fut.cancel()  # drop it if still queued; a racing flush is harmless
+            # outcome unknown (the flush may still land): return the probe
+            # slot so the next request can probe again rather than the
+            # breaker staying half_open with zero slots forever
+            self.breaker.release_probe()
+            self.counters.inc("deadline_exceeded")
+            raise DeadlineExceededError(
+                f"request exceeded the {res.request_deadline_s}s deadline"
+            ) from exc
+        except Exception:
+            self.counters.inc("dispatch_failures")
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return result
+
     def adapt(self, x_support, y_support) -> Dict[str, Any]:
         t0 = time.monotonic()
         x, y = self.engine._flatten_support(x_support, y_support)
@@ -77,7 +151,7 @@ class ServingFrontend:
         cached = self.cache.get(key) is not None
         if not cached:
             bucket = self.engine.support_bucket(x.shape[0])
-            fast_weights = self._adapt_batcher.submit(bucket, (x, y)).result()
+            fast_weights = self._dispatch(self._adapt_batcher, bucket, (x, y))
             self.cache.put(key, fast_weights)
         elapsed = time.monotonic() - t0
         self.latency.record("adapt_cached" if cached else "adapt", elapsed)
@@ -98,7 +172,7 @@ class ServingFrontend:
             )
         x = np.asarray(x_query, np.float32)
         bucket = self.engine.query_bucket(x.shape[0])
-        probs = self._predict_batcher.submit(bucket, (fast_weights, x)).result()
+        probs = self._dispatch(self._predict_batcher, bucket, (fast_weights, x))
         self.latency.record("predict", time.monotonic() - t0)
         return probs
 
@@ -110,8 +184,16 @@ class ServingFrontend:
     # ------------------------------------------------------------------
 
     def healthz(self) -> Dict[str, Any]:
+        # degraded = serving, but in a mode a load balancer / operator should
+        # react to: the engine breaker is open (device dispatch failing) or
+        # half-open (probing). The HTTP layer returns 503 for degraded so
+        # orchestrators drain traffic away; OPERATIONS.md "Degraded modes".
+        breaker_state = self.breaker.state
+        degraded = [] if breaker_state == "closed" else [f"breaker_{breaker_state}"]
         return {
-            "status": "ok",
+            "status": "degraded" if degraded else "ok",
+            "degraded": degraded,
+            "breaker": self.breaker.snapshot(),
             "platform": jax.default_backend(),
             "checkpoint_fingerprint": self.engine.fingerprint,
             "model": self.engine.system.model.name,
@@ -127,6 +209,11 @@ class ServingFrontend:
             "adapt_batcher": self._adapt_batcher.stats(),
             "predict_batcher": self._predict_batcher.stats(),
             "compiled": self.engine.compile_counts(),
+            "resilience": {
+                **self.counters.snapshot(),
+                "breaker": self.breaker.snapshot(),
+                "injected_faults": self.engine.injector.stats(),
+            },
             "uptime_s": round(time.monotonic() - self._started, 1),
         }
 
@@ -154,11 +241,15 @@ class _Handler(BaseHTTPRequestHandler):
     # the frontend is attached to the server instance by make_http_server
     protocol_version = "HTTP/1.1"
 
-    def _send_json(self, code: int, payload: Dict[str, Any]) -> None:
+    def _send_json(
+        self, code: int, payload: Dict[str, Any], headers: Optional[Dict[str, str]] = None
+    ) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -175,7 +266,10 @@ class _Handler(BaseHTTPRequestHandler):
         frontend: ServingFrontend = self.server.frontend  # type: ignore[attr-defined]
         try:
             if self.path == "/healthz":
-                self._send_json(200, frontend.healthz())
+                health = frontend.healthz()
+                # 503 on degraded so load balancers drain without parsing
+                # the body; the body still says exactly what is degraded
+                self._send_json(200 if health["status"] == "ok" else 503, health)
             elif self.path == "/metrics":
                 self._send_json(200, frontend.metrics())
             else:
@@ -186,6 +280,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802
         frontend: ServingFrontend = self.server.frontend  # type: ignore[attr-defined]
         try:
+            # fault seam for handler-level drills (raise -> 500, delay)
+            frontend.engine.injector.fire("serving.http")
             req = self._read_json()
             if self.path == "/adapt":
                 out = frontend.adapt(req["x_support"], req["y_support"])
@@ -201,6 +297,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, out)
             else:
                 self._send_json(404, {"error": f"unknown path {self.path}"})
+        except ServiceUnavailableError as exc:
+            # load shed / breaker open: tell the client when to come back
+            self._send_json(
+                503,
+                {"error": str(exc), "retry_after_s": exc.retry_after_s},
+                # Retry-After is integer seconds (RFC 9110); round up so a
+                # sub-second hint doesn't become an immediate retry storm
+                headers={"Retry-After": str(max(1, int(round(exc.retry_after_s))))},
+            )
+        except DeadlineExceededError as exc:
+            self._send_json(504, {"error": str(exc)})
         except UnknownAdaptationError as exc:
             self._send_json(404, {"error": str(exc)})
         except (KeyError, ValueError, TypeError) as exc:

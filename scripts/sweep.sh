@@ -27,7 +27,9 @@ MAX_RESTARTS=${MAX_RESTARTS:-8}
 run () {
   name=$1; shift
   out="exps/${name}.out"
-  for attempt in $(seq 0 $MAX_RESTARTS); do
+  attempt=0
+  preempts=0
+  while [ "$attempt" -le "$MAX_RESTARTS" ]; do
     # don't burn an attempt against a wedged tunnel: wait (<=1h) until a
     # bounded probe actually sees the chip
     python -u scripts/wait_for_tpu.py >> exps/sweep_r3.log 2>&1 || \
@@ -52,11 +54,29 @@ run () {
     echo "=== $(date -u +%H:%M:%S) $name attempt=$attempt rc=$rc" >> exps/sweep_r3.log
     [ $rc -eq 0 ] && return 0
     if [ $rc -eq 3 ]; then
-      # runner's early-divergence abort: permanent, not a transient failure —
-      # retrying resumes the same collapsing trajectory
+      # runner's divergence abort (early-abort OR exhausted NaN-rollback
+      # ladder): permanent, not a transient failure — retrying resumes the
+      # same collapsing trajectory
       echo "=== $(date -u +%H:%M:%S) $name EARLY-ABORTED (diverged), not retrying" >> exps/sweep_r3.log
       return 1
     fi
+    if [ $rc -eq 75 ]; then
+      # runner's preemption exit (resilience.preemption_exit_code, SIGTERM/
+      # SIGINT emergency checkpoint): restart-not-fail — the checkpoint
+      # carries the mid-epoch cursor, resume is exact and makes progress,
+      # so don't burn a watchdog attempt on it
+      # bounded: each restart resumes mid-epoch (forward progress), but a
+      # SIGTERM-happy environment must not loop forever
+      preempts=$((preempts + 1))
+      if [ "$preempts" -gt $((MAX_RESTARTS * 3)) ]; then
+        echo "=== $(date -u +%H:%M:%S) $name preempted $preempts times, giving up" >> exps/sweep_r3.log
+        return 1
+      fi
+      echo "=== $(date -u +%H:%M:%S) $name PREEMPTED (emergency checkpoint), restarting free ($preempts)" >> exps/sweep_r3.log
+      sleep 2
+      continue
+    fi
+    attempt=$((attempt + 1))
     sleep 10   # let the tunnel lease clear before reconnecting
   done
   echo "=== $(date -u +%H:%M:%S) $name FAILED after $MAX_RESTARTS restarts" >> exps/sweep_r3.log

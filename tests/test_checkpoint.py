@@ -36,6 +36,29 @@ def test_roundtrip_exact(tmp_path):
     assert tree_allclose(s_cont.params, s_res.params, rtol=1e-6, atol=1e-7)
 
 
+def test_checkpoint_embeds_verifiable_digest(tmp_path):
+    """Format 2 (resilience subsystem): the file wraps the msgpack body with
+    its sha256; quarantine renames rather than deletes, and the quarantined
+    file disappears from epoch discovery."""
+    from flax import serialization
+
+    cfg = tiny_config()
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    ckpt.save_checkpoint(str(tmp_path), system.init_train_state(), {"epoch": 0}, 0)
+    with open(tmp_path / "train_model_0", "rb") as f:
+        outer = serialization.msgpack_restore(f.read())
+    assert outer["format"] == ckpt.CHECKPOINT_FORMAT == 2
+    import hashlib
+
+    assert hashlib.sha256(outer["body"]).hexdigest() == outer["sha256"]
+    assert ckpt.available_epochs(str(tmp_path)) == [0]
+    quarantined = ckpt.quarantine(str(tmp_path), 0)
+    assert quarantined.endswith(".corrupt")
+    assert ckpt.available_epochs(str(tmp_path)) == []
+    assert not ckpt.checkpoint_exists(str(tmp_path), 0)
+    assert ckpt.quarantine(str(tmp_path), 0) is None  # already gone: no-op
+
+
 def test_rotation_keeps_max_models(tmp_path):
     cfg = tiny_config()
     system = MAMLSystem(cfg, model=tiny_linear_model())

@@ -1,0 +1,666 @@
+"""The fault matrix, end to end (ISSUE 2 acceptance drills): checkpoint
+integrity + quarantine + resume fallback, the NaN skip/rollback/abort ladder,
+SIGTERM -> emergency save -> exact mid-epoch resume, loader transient-I/O
+retry, serving load-shedding / deadlines / circuit breaker — all with fake
+clocks or zero backoff (no real sleeps), and the disabled-injector
+bit-identity guarantee."""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from howtotrainyourmamlpytorch_tpu.config import Config, ResilienceConfig, ServingConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data import MetaLearningDataLoader
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
+from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+from howtotrainyourmamlpytorch_tpu.experiment.storage import load_statistics
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+from howtotrainyourmamlpytorch_tpu.resilience import (
+    CircuitBreaker,
+    DeadlineExceededError,
+    FaultInjector,
+    FaultSpec,
+    InjectedFault,
+    retry_call,
+)
+from howtotrainyourmamlpytorch_tpu.serving import (
+    AdaptationEngine,
+    MicroBatcher,
+    QueueFullError,
+    ServiceUnavailableError,
+    ServingFrontend,
+    make_http_server,
+)
+
+from tests.test_runner import runner_config, small_system, toy_dataset  # noqa: F401
+
+# ---------------------------------------------------------------------------
+# fault injector
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parse_and_validation():
+    spec = FaultSpec.parse("checkpoint.read=corrupt-bytes:nth=2")
+    assert (spec.site, spec.kind, spec.nth) == ("checkpoint.read", "corrupt-bytes", 2)
+    spec = FaultSpec.parse("serving.http=delay:delay_s=0.5,p=0.25")
+    assert (spec.delay_s, spec.p) == (0.5, 0.25)
+    for bad in ("no-equals", "site=unknown-kind", "s=raise:p=2.0", "s=raise:bogus=1"):
+        with pytest.raises(ValueError):
+            FaultSpec.parse(bad)
+    # a typo'd drill spec fails at config construction, not mid-run
+    with pytest.raises(ValueError):
+        ResilienceConfig(faults=["runner.step=bogus"])
+    # breaker knobs validate against CircuitBreaker's own >= 1 contract at
+    # config load, not at serving startup
+    with pytest.raises(ValueError, match="breaker_failure_threshold"):
+        ResilienceConfig(breaker_failure_threshold=0)
+    with pytest.raises(ValueError, match="breaker_half_open_probes"):
+        ResilienceConfig(breaker_half_open_probes=0)
+
+
+def test_injector_after_window_expresses_mid_run_burst():
+    """The OPERATIONS.md drill 'after=39,times=3' = a burst on calls 40-42."""
+    inj = FaultInjector.from_specs(["a=nan-loss:after=2,times=3"], include_env=False)
+    assert [inj.fire("a") for _ in range(7)] == [
+        None, None, "nan-loss", "nan-loss", "nan-loss", None, None,
+    ]
+
+
+def test_injector_triggers_and_determinism():
+    inj = FaultInjector.from_specs(["a=nan-loss:times=2"], include_env=False)
+    assert [inj.fire("a") for _ in range(4)] == ["nan-loss", "nan-loss", None, None]
+    assert inj.stats() == {"a:nan-loss": 2}
+    inj = FaultInjector.from_specs(["a=nan-loss:nth=3"], include_env=False)
+    assert [inj.fire("a") for _ in range(4)] == [None, None, "nan-loss", None]
+    # p-triggers are a pure function of (seed, site, call index)
+    fires = [
+        [FaultInjector.from_specs(["a=nan-loss:p=0.5"], seed=7, include_env=False).fire("a")
+         for _ in range(1)]
+        for _ in range(3)
+    ]
+    assert fires[0] == fires[1] == fires[2]
+    # disabled injector: inert on every entry point, payload passed through
+    inert = FaultInjector()
+    assert not inert.enabled
+    assert inert.fire("anything") is None
+    assert inert.fire_bytes("anything", b"payload") == b"payload"
+    # kind=raise raises the OSError subclass the retry layer catches
+    inj = FaultInjector.from_specs(["io=raise:nth=1"], include_env=False)
+    with pytest.raises(InjectedFault):
+        inj.fire("io")
+
+
+def test_injector_corrupt_bytes_deterministic():
+    inj1 = FaultInjector.from_specs(["w=corrupt-bytes:nth=1"], include_env=False)
+    inj2 = FaultInjector.from_specs(["w=corrupt-bytes:nth=1"], include_env=False)
+    blob = bytes(range(256))
+    a, b = inj1.fire_bytes("w", blob), inj2.fire_bytes("w", blob)
+    assert a == b and a != blob and len(a) == len(blob)
+
+
+# ---------------------------------------------------------------------------
+# retry + breaker (fake clocks; zero real sleeping)
+# ---------------------------------------------------------------------------
+
+
+def test_retry_call_exponential_backoff_fake_clock():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert (
+        retry_call(flaky, retries=3, backoff_s=0.1, jitter=0.5,
+                   sleep=sleeps.append, clock=lambda: 0.0)
+        == "ok"
+    )
+    assert len(sleeps) == 2
+    # exponential (0.1, 0.2) with up to 50% jitter on top
+    assert 0.1 <= sleeps[0] <= 0.15
+    assert 0.2 <= sleeps[1] <= 0.3
+    # exhausted retries re-raise the original error
+    with pytest.raises(OSError, match="always"):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("always")),
+                   retries=1, backoff_s=0.0, sleep=lambda s: None)
+    # non-retryable exceptions pass straight through, no retry burned
+    calls["n"] = 0
+
+    def type_error():
+        calls["n"] += 1
+        raise TypeError("bug, not transience")
+
+    with pytest.raises(TypeError):
+        retry_call(type_error, retries=3, backoff_s=0.0, sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_retry_call_deadline_fake_clock():
+    t = {"now": 0.0}
+
+    def slow_fail():
+        t["now"] += 10.0
+        raise OSError("down")
+
+    with pytest.raises(DeadlineExceededError):
+        retry_call(slow_fail, retries=5, backoff_s=1.0, deadline_s=15.0,
+                   sleep=lambda s: None, clock=lambda: t["now"])
+
+
+def test_circuit_breaker_state_machine_fake_clock():
+    t = {"now": 0.0}
+    b = CircuitBreaker(failure_threshold=3, cooldown_s=10.0, half_open_probes=1,
+                       clock=lambda: t["now"])
+    assert b.state == "closed" and b.allow()
+    # non-consecutive failures never trip it
+    b.record_failure(); b.record_failure(); b.record_success()
+    b.record_failure(); b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open" and not b.allow() and b.opens == 1
+    # cooldown not elapsed: still rejecting
+    t["now"] = 9.0
+    assert not b.allow()
+    # cooldown elapsed: half-open, one probe slot
+    t["now"] = 11.0
+    assert b.state == "half_open"
+    assert b.allow()
+    assert not b.allow()  # second concurrent probe rejected
+    # probe failure re-opens with a fresh cooldown
+    b.record_failure()
+    assert b.state == "open" and b.opens == 2
+    t["now"] = 22.0
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+    snap = b.snapshot()
+    assert snap["state"] == "closed" and snap["opens"] == 2 and snap["rejections"] >= 2
+
+
+def test_breaker_released_probe_slot_is_not_leaked():
+    """Regression: a half-open probe whose call never resolves (shed before
+    dispatch / deadline timeout) must return its slot — otherwise the breaker
+    wedges in half_open rejecting everything forever."""
+    t = {"now": 0.0}
+    b = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, half_open_probes=1,
+                       clock=lambda: t["now"])
+    b.record_failure()
+    t["now"] = 6.0
+    assert b.allow()  # the only probe slot, consumed
+    assert not b.allow()  # wedged without release...
+    b.release_probe()  # ...the unresolved call gives it back
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed"
+    # no-op outside half-open
+    b.release_probe()
+    assert b.state == "closed" and b.allow()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: digest, quarantine, resume fallback
+# ---------------------------------------------------------------------------
+
+
+def _corrupt_file(path):
+    blob = bytearray(open(path, "rb").read())
+    mid = len(blob) // 2
+    for i in range(mid, mid + 8):
+        blob[i] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+
+
+def test_corrupt_checkpoint_detected_and_legacy_loads(tmp_path):
+    from flax import serialization
+    from tests.test_maml_core import tiny_config, tiny_linear_model
+
+    system = MAMLSystem(tiny_config(), model=tiny_linear_model())
+    state = system.init_train_state()
+    ckpt.save_checkpoint(str(tmp_path), state, {"epoch": 0}, 0)
+    # flipping bytes on disk fails the embedded-digest check
+    _corrupt_file(str(tmp_path / "train_model_0"))
+    with pytest.raises(ckpt.CheckpointCorruptError, match="sha256 mismatch"):
+        ckpt.load_checkpoint(str(tmp_path), 0, system.init_train_state())
+    # truncation is corruption too, not a decode crash
+    blob = open(str(tmp_path / "train_model_latest"), "rb").read()
+    open(str(tmp_path / "train_model_1"), "wb").write(blob[: len(blob) // 3])
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_checkpoint(str(tmp_path), 1, system.init_train_state())
+    # a pre-format-2 file (bare payload, no digest wrapper) still loads —
+    # old runs and their forensic tooling keep working
+    legacy = serialization.msgpack_serialize(
+        {
+            "network": serialization.to_bytes(
+                jax.tree.map(np.asarray, state)
+            ),
+            "bookkeeping": {"epoch": 4},
+        }
+    )
+    open(str(tmp_path / "train_model_4"), "wb").write(legacy)
+    restored, book = ckpt.load_checkpoint(str(tmp_path), 4, system.init_train_state())
+    assert book == {"epoch": 4}
+    inf, _ = ckpt.load_for_inference(str(tmp_path), 4)
+    assert len(inf.fingerprint) == 64
+
+
+def test_corrupt_latest_falls_back_and_quarantines(toy_dataset, tmp_path):
+    """Acceptance drill (a): corrupting train_model_latest on disk makes
+    resume fall back to the newest valid epoch and quarantine the bad file."""
+    cfg = runner_config(toy_dataset, tmp_path, experiment_name="toy_fallback")
+    runner = ExperimentRunner(cfg, system=small_system(cfg))
+    runner.run_experiment()  # 2 epochs -> train_model_{0,1} + latest
+    save_dir = runner.saved_models_dir
+    latest = os.path.join(save_dir, "train_model_latest")
+    _corrupt_file(latest)
+
+    cfg2 = runner_config(toy_dataset, tmp_path, experiment_name="toy_fallback",
+                         total_epochs=3)
+    runner2 = ExperimentRunner(cfg2, system=small_system(cfg2))
+    # fell back to epoch file 1 => resume still at epoch 2, nothing retrained
+    assert runner2.start_epoch == 2
+    # the corrupt file is quarantined, not deleted, and no longer discoverable
+    assert os.path.exists(latest + ".corrupt")
+    assert not os.path.exists(latest)
+    assert ckpt.available_epochs(save_dir) == [0, 1]
+    runner2.run_experiment()
+    assert len(load_statistics(os.path.join(runner2.run_dir, "logs"))) == 3
+
+
+def test_resume_raises_when_every_checkpoint_corrupt(toy_dataset, tmp_path):
+    cfg = runner_config(toy_dataset, tmp_path, experiment_name="toy_allcorrupt",
+                        total_epochs=1)
+    runner = ExperimentRunner(cfg, system=small_system(cfg))
+    runner.run_experiment()
+    save_dir = runner.saved_models_dir
+    for name in os.listdir(save_dir):
+        _corrupt_file(os.path.join(save_dir, name))
+    with pytest.raises(ckpt.CheckpointCorruptError, match="no valid checkpoint"):
+        ExperimentRunner(cfg, system=small_system(cfg))
+
+
+# ---------------------------------------------------------------------------
+# NaN sentinel: skip -> rollback (LR backoff) -> rc=3 abort
+# ---------------------------------------------------------------------------
+
+
+def _events(run_dir):
+    path = os.path.join(run_dir, "logs", "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_nan_step_skipped_then_rollback_with_lr_backoff(toy_dataset, tmp_path):
+    """Acceptance drill (b), recoverable half: an injected NaN step is
+    discarded; K consecutive discards roll back to the last good state with
+    an LR backoff, and the run still completes."""
+    cfg = runner_config(
+        toy_dataset, tmp_path, experiment_name="toy_nan_rollback",
+        resilience=ResilienceConfig(
+            faults=["runner.step=nan-loss:times=1"],
+            max_consecutive_bad_steps=1,  # K=1: first discard triggers rollback
+            max_rollbacks=2,
+            rollback_lr_backoff=0.5,
+        ),
+    )
+    system = small_system(cfg)
+    runner = ExperimentRunner(cfg, system=system)
+    result = runner.run_experiment()
+    assert "test_accuracy_mean" in result  # completed despite the poisoned step
+    events = [e.get("event") for e in _events(runner.run_dir)]
+    assert "nan_step_skipped" in events
+    assert "nan_rollback" in events
+    assert "nan_abort" not in events
+    # the rollback shrank the outer LR schedule
+    assert system.meta_lr_scale == pytest.approx(0.5)
+    # stats still aggregated from the surviving steps
+    rows = load_statistics(os.path.join(runner.run_dir, "logs"))
+    assert len(rows) == cfg.total_epochs
+    assert np.isfinite(float(rows[0]["train_loss_mean"]))
+
+
+def test_nan_abort_rc3_after_failed_rollbacks(toy_dataset, tmp_path):
+    """Acceptance drill (b), unrecoverable half: persistent NaNs exhaust the
+    rollback budget and exit with the permanent code 3 (sweep.sh: diverged,
+    do not restart)."""
+    cfg = runner_config(
+        toy_dataset, tmp_path, experiment_name="toy_nan_abort",
+        total_iter_per_epoch=6,
+        resilience=ResilienceConfig(
+            faults=["runner.step=nan-loss:p=1.0"],
+            max_consecutive_bad_steps=1,
+            max_rollbacks=1,
+        ),
+    )
+    runner = ExperimentRunner(cfg, system=small_system(cfg))
+    with pytest.raises(SystemExit) as exc:
+        runner.run_experiment()
+    assert exc.value.code == 3
+    events = [e.get("event") for e in _events(runner.run_dir)]
+    assert "nan_rollback" in events and "nan_abort" in events
+
+
+def test_nan_guard_disabled_or_clean_is_bit_identical(toy_dataset, tmp_path):
+    """With no faults injected, the sentinel's observation path (guard on,
+    the default) produces bit-identical parameters to guard off — detection
+    must not perturb the math."""
+    cfg_on = runner_config(toy_dataset, tmp_path, experiment_name="toy_guard_on",
+                           total_epochs=1)
+    cfg_off = runner_config(
+        toy_dataset, tmp_path, experiment_name="toy_guard_off", total_epochs=1,
+        resilience=ResilienceConfig(nan_guard=False),
+    )
+    r_on = ExperimentRunner(cfg_on, system=small_system(cfg_on))
+    r_on.run_experiment()
+    r_off = ExperimentRunner(cfg_off, system=small_system(cfg_off))
+    r_off.run_experiment()
+    for a, b in zip(jax.tree.leaves(r_on.state.params), jax.tree.leaves(r_off.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# preemption: SIGTERM mid-epoch -> emergency save -> exact resume
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_mid_epoch_emergency_save_then_exact_resume(toy_dataset, tmp_path):
+    """Acceptance drill (c): SIGTERM mid-epoch produces a checkpoint that
+    resumes on the exact next iteration — the interrupted-then-resumed run
+    ends with the same parameters as an uninterrupted control run on the
+    same stream."""
+    # control: uninterrupted 2-epoch run
+    cfg_ctl = runner_config(toy_dataset, tmp_path, experiment_name="toy_ctl")
+    r_ctl = ExperimentRunner(cfg_ctl, system=small_system(cfg_ctl))
+    r_ctl.run_experiment()
+
+    # interrupted: the injector SIGTERMs this very process at step 2 of
+    # epoch 0 (3 iters/epoch); the runner's handler flags it, the loop
+    # saves an emergency 'latest' and exits the preemption code
+    cfg_a = runner_config(
+        toy_dataset, tmp_path, experiment_name="toy_preempt",
+        resilience=ResilienceConfig(faults=["runner.step=sigterm:nth=2"]),
+    )
+    r_a = ExperimentRunner(cfg_a, system=small_system(cfg_a))
+    with pytest.raises(SystemExit) as exc:
+        r_a.run_experiment()
+    assert exc.value.code == cfg_a.resilience.preemption_exit_code == 75
+    events = _events(r_a.run_dir)
+    assert any(e.get("event") == "preempted" for e in events)
+    # the emergency checkpoint carries the mid-epoch cursor
+    _, book = ckpt.load_checkpoint(r_a.saved_models_dir, "latest", r_a.state)
+    assert book["epoch"] == -1  # no epoch completed yet
+    assert book["mid_epoch_iter"] == 2  # steps 0 and 1 ran
+    assert book["train_episodes_produced"] == 2 * r_a.loader.batch_size
+
+    # resume: picks up at exactly iteration 2 of epoch 0
+    cfg_b = runner_config(toy_dataset, tmp_path, experiment_name="toy_preempt")
+    r_b = ExperimentRunner(cfg_b, system=small_system(cfg_b))
+    assert r_b.start_epoch == 0
+    assert r_b.loader.train_episodes_produced == 2 * r_b.loader.batch_size
+    r_b.run_experiment()
+
+    # same stream, same arithmetic: identical final parameters
+    for a, b in zip(jax.tree.leaves(r_ctl.state.params), jax.tree.leaves(r_b.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+    # and the full artifact set exists for the resumed run
+    assert len(load_statistics(os.path.join(r_b.run_dir, "logs"))) >= 2
+
+
+# ---------------------------------------------------------------------------
+# loader transient-I/O retry
+# ---------------------------------------------------------------------------
+
+
+def test_loader_retries_transient_episode_io(toy_dataset, tmp_path):
+    cfg = runner_config(
+        toy_dataset, tmp_path, experiment_name="toy_loader_retry",
+        resilience=ResilienceConfig(
+            faults=["loader.episode=raise:nth=1"], loader_io_backoff_s=0.0
+        ),
+    )
+    inj = FaultInjector.from_specs(cfg.resilience.faults, include_env=False)
+    loader = MetaLearningDataLoader(cfg, injector=inj)
+    try:
+        batch = next(iter(loader.train_batches(1)))
+        assert batch["x_support"].shape[0] == loader.batch_size
+        assert loader.io_retries_used == 1
+        assert inj.stats() == {"loader.episode:raise": 1}
+    finally:
+        loader.close()
+
+
+def test_loader_persistent_io_failure_still_raises(toy_dataset, tmp_path):
+    cfg = runner_config(
+        toy_dataset, tmp_path, experiment_name="toy_loader_fail",
+        resilience=ResilienceConfig(
+            faults=["loader.episode=raise:p=1.0"],
+            loader_io_retries=1, loader_io_backoff_s=0.0,
+        ),
+    )
+    inj = FaultInjector.from_specs(cfg.resilience.faults, include_env=False)
+    loader = MetaLearningDataLoader(cfg, injector=inj)
+    try:
+        with pytest.raises(InjectedFault):
+            next(iter(loader.train_batches(1)))
+    finally:
+        loader.close()
+
+
+# ---------------------------------------------------------------------------
+# serving: shed, deadline, breaker
+# ---------------------------------------------------------------------------
+
+_IMG = (28, 28, 1)
+
+
+def _tiny_engine(injector=None, **serving_kwargs):
+    cfg = Config(
+        num_classes_per_set=5,
+        num_samples_per_class=2,
+        num_target_samples=3,
+        batch_size=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        serving=ServingConfig(
+            support_buckets=[16], query_buckets=[16], **serving_kwargs
+        ),
+    )
+    system = MAMLSystem(
+        cfg, model=build_vgg(_IMG, cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4)
+    )
+    return AdaptationEngine(
+        system, system.init_train_state(),
+        injector=injector or FaultInjector(),
+    )
+
+
+def _support(seed):
+    ep = synthetic_batch(1, 5, 2, 3, _IMG, seed=seed)
+    return ep["x_support"][0], ep["y_support"][0]
+
+
+def test_batcher_sheds_beyond_max_queue_depth():
+    entered, release = threading.Event(), threading.Event()
+
+    def flush(bucket, payloads):
+        entered.set()
+        release.wait(5.0)
+        return payloads
+
+    b = MicroBatcher(flush, max_batch=1, deadline_ms=0, max_queue_depth=2, name="t")
+    try:
+        futs = [b.submit("k", 0)]
+        assert entered.wait(5.0)  # worker now parked inside the first flush
+        futs += [b.submit("k", 1), b.submit("k", 2)]  # queue at capacity
+        assert b.queue_depth() == 2
+        with pytest.raises(QueueFullError):
+            b.submit("k", 99)
+        assert b.stats()["shed"] == 1
+        release.set()
+        assert [f.result(5.0) for f in futs] == [0, 1, 2]
+    finally:
+        release.set()
+        b.close()
+
+
+def test_batcher_worker_survives_cancelled_futures():
+    """Regression: a future cancelled while queued (or racing a flush) must
+    not kill the worker thread with InvalidStateError — later submits still
+    get served."""
+    entered, release = threading.Event(), threading.Event()
+
+    def flush(bucket, payloads):
+        entered.set()
+        release.wait(5.0)
+        return payloads
+
+    b = MicroBatcher(flush, max_batch=1, deadline_ms=0, name="t")
+    try:
+        inflight = b.submit("k", 1)
+        assert entered.wait(5.0)
+        queued = b.submit("k", 2)
+        assert queued.cancel()  # cancelled while still queued: never flushed
+        assert inflight.cancel()  # races the running flush: outcome discarded
+        release.set()
+        assert b.submit("k", 3).result(5.0) == 3  # worker alive and serving
+    finally:
+        release.set()
+        b.close()
+
+
+@pytest.fixture(scope="module")
+def breaker_frontend():
+    """Frontend over a tiny engine whose first 2 dispatches are injected
+    failures; breaker threshold 2, fake clock."""
+    inj = FaultInjector.from_specs(["serving.dispatch=raise:times=2"], include_env=False)
+    engine = _tiny_engine(injector=inj)
+    clock = {"now": 0.0}
+    res = ResilienceConfig(
+        breaker_failure_threshold=2, breaker_cooldown_s=30.0,
+        request_deadline_s=30.0, max_queue_depth=64,
+    )
+    frontend = ServingFrontend(engine, resilience_cfg=res, clock=lambda: clock["now"])
+    yield frontend, clock
+    frontend.close()
+
+
+def test_breaker_opens_half_opens_closes(breaker_frontend):
+    """Acceptance drill (d), breaker half: repeated device failures open the
+    breaker (fail-fast 503s, degraded /healthz); after the cooldown a probe
+    half-opens it and success closes it again."""
+    frontend, clock = breaker_frontend
+    # two injected dispatch failures -> breaker trips
+    for seed in (1, 2):
+        with pytest.raises(InjectedFault):
+            frontend.adapt(*_support(seed))
+    assert frontend.breaker.state == "open"
+    assert frontend.healthz()["status"] == "degraded"
+    # while open: immediate ServiceUnavailable, engine never reached
+    with pytest.raises(ServiceUnavailableError):
+        frontend.adapt(*_support(3))
+    assert frontend.counters.get("breaker_rejected") == 1
+    assert frontend.counters.get("dispatch_failures") == 2
+    # cooldown elapses on the fake clock -> half-open probe succeeds -> closed
+    clock["now"] = 31.0
+    assert frontend.breaker.state == "half_open"
+    out = frontend.adapt(*_support(4))
+    assert out["cached"] is False
+    assert frontend.breaker.state == "closed"
+    health = frontend.healthz()
+    assert health["status"] == "ok" and health["breaker"]["opens"] == 1
+    metrics = frontend.metrics()
+    assert metrics["resilience"]["breaker"]["state"] == "closed"
+    assert metrics["resilience"]["injected_faults"] == {"serving.dispatch:raise": 2}
+
+
+def test_http_shed_returns_503_with_retry_after():
+    """Acceptance drill (d), shed half: beyond the configured queue depth the
+    HTTP layer sheds with 503 + Retry-After instead of queueing unboundedly.
+    (Depth 0 = every request sheds — the degenerate bound that needs no
+    blocked flush to demonstrate the full HTTP mapping.)"""
+    engine = _tiny_engine()
+    res = ResilienceConfig(max_queue_depth=0, shed_retry_after_s=2.0)
+    frontend = ServingFrontend(engine, resilience_cfg=res)
+    server = make_http_server(frontend, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        x_s, y_s = _support(5)
+        req = urllib.request.Request(
+            base + "/adapt",
+            data=json.dumps({"x_support": x_s.tolist(), "y_support": y_s.tolist()}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc.value.code == 503
+        assert exc.value.headers["Retry-After"] == "2"
+        body = json.loads(exc.value.read())
+        assert "retry_after_s" in body
+        # the shed is counted where the runbook says to look
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+            metrics = json.loads(resp.read())
+        assert metrics["resilience"]["shed"] == 1
+        assert metrics["adapt_batcher"]["shed"] == 1
+        # healthz stays 200/ok: shedding is overload, not device failure
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as resp:
+            assert json.loads(resp.read())["status"] == "ok"
+    finally:
+        server.shutdown()
+        server.server_close()
+        frontend.close()
+        thread.join(timeout=5)
+
+
+def test_request_deadline_maps_to_gateway_timeout():
+    inj = FaultInjector.from_specs(
+        ["serving.dispatch=delay:delay_s=0.3,times=1"], include_env=False
+    )
+    engine = _tiny_engine(injector=inj)
+    res = ResilienceConfig(request_deadline_s=0.01)
+    frontend = ServingFrontend(engine, resilience_cfg=res)
+    try:
+        with pytest.raises(DeadlineExceededError):
+            frontend.adapt(*_support(6))
+        assert frontend.counters.get("deadline_exceeded") == 1
+        # a deadline miss says nothing about device health: breaker untouched
+        assert frontend.breaker.state == "closed"
+    finally:
+        frontend.close()
+
+
+def test_healthz_degraded_returns_503_over_http():
+    engine = _tiny_engine()
+    res = ResilienceConfig(breaker_failure_threshold=1, breaker_cooldown_s=60.0)
+    frontend = ServingFrontend(engine, resilience_cfg=res)
+    frontend.breaker.record_failure()  # trip it directly
+    server = make_http_server(frontend, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/healthz", timeout=30)
+        assert exc.value.code == 503
+        body = json.loads(exc.value.read())
+        assert body["status"] == "degraded"
+        assert body["degraded"] == ["breaker_open"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        frontend.close()
+        thread.join(timeout=5)
