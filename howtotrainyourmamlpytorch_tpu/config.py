@@ -177,6 +177,13 @@ class ResilienceConfig:
     breaker_failure_threshold: int = 5
     breaker_cooldown_s: float = 10.0
     breaker_half_open_probes: int = 1
+    # consecutive request-deadline timeouts that trip the breaker — the
+    # wedged-backend (hang) signature, which never raises and so never feeds
+    # breaker_failure_threshold. A separate knob, defaulted LOWER than the
+    # failure threshold: every timeout already burns a full
+    # request_deadline_s before the client hears anything, so a hung device
+    # should go fast-503 after fewer events than instant raising failures
+    breaker_timeout_threshold: int = 3
     # --- fault injection (resilience/faults.py; spec grammar documented
     # there; HTYMP_FAULTS env specs are merged in at injector build) ---
     faults: List[str] = field(default_factory=list)
@@ -200,7 +207,11 @@ class ResilienceConfig:
                 raise ValueError(f"resilience.{name} must be >= 0, got {getattr(self, name)}")
         # match CircuitBreaker's own constructor contract so a bad value
         # bounces here, not at serving startup hours later
-        for name in ("breaker_failure_threshold", "breaker_half_open_probes"):
+        for name in (
+            "breaker_failure_threshold",
+            "breaker_half_open_probes",
+            "breaker_timeout_threshold",
+        ):
             if getattr(self, name) < 1:
                 raise ValueError(f"resilience.{name} must be >= 1, got {getattr(self, name)}")
         if not 0.0 < self.rollback_lr_backoff <= 1.0:

@@ -265,6 +265,10 @@ class ExperimentRunner:
                 return False
             losses.append(loss_host)
             accs.append(np.atleast_1d(np.asarray(jax.device_get(acc_dev))))
+            # a good step breaks the streak: the K threshold counts
+            # CONSECUTIVE discards, not discards-since-last-rollback —
+            # isolated NaNs hours apart must never add up to a rollback
+            self._bad_steps = 0
             return True
 
         preempted = False

@@ -21,7 +21,7 @@ where the state lives: ``experiment/runner.py``, ``experiment/checkpoint.py``,
 (``config.py::ResilienceConfig``); drills: ``docs/OPERATIONS.md``.
 """
 
-from .breaker import CircuitBreaker  # noqa: F401
+from .breaker import CircuitBreaker, Permit  # noqa: F401
 from .faults import (  # noqa: F401
     ENV_VAR,
     NULL_INJECTOR,

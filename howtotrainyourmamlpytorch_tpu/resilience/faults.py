@@ -25,8 +25,9 @@ Sites wired in this codebase (grep for ``fire(`` / ``fire_bytes(``):
                       sentinel, sigterm = preemption drill, delay, raise)
 ``serving.dispatch``  ``serving/engine.py`` — device dispatch of a batched
                       adapt/predict flush (raise trips the circuit breaker)
-``serving.http``      ``serving/server.py`` — request handler entry (raise
-                      = handler bug -> 500, delay = slow client path)
+``serving.http``      ``serving/server.py`` — request handler, after the
+                      body is drained (raise = handler bug -> 500, delay =
+                      slow client path)
 ==================  ========================================================
 
 Spec grammar (one string per fault; ``;``-separated when packed into the
@@ -54,6 +55,7 @@ Examples::
 
 import os
 import signal
+import threading
 import time
 import zlib
 from dataclasses import dataclass
@@ -134,6 +136,11 @@ class FaultInjector:
         self.seed = seed
         self._sleep = sleep
         self._kill = kill
+        # several sites fire from concurrent threads (loader prefetch pool,
+        # batcher workers, ThreadingHTTPServer handlers) sharing one
+        # injector: the call counters must be atomic or nth/times/p triggers
+        # lose their deterministic-replay guarantee exactly at those seams
+        self._lock = threading.Lock()
         self._calls: Dict[str, int] = {}
         # (site, kind) -> times fired; the observability surface for drills
         self.fired: Dict[str, int] = {}
@@ -165,23 +172,24 @@ class FaultInjector:
         specs = self._by_site.get(site)
         if not specs:
             return None
-        call = self._calls.get(site, 0) + 1
-        self._calls[site] = call
-        for spec in specs:
-            if spec.nth and call != spec.nth:
-                continue
-            if spec.after and call <= spec.after:
-                continue
-            if spec.times and call > spec.after + spec.times:
-                continue
-            if spec.p < 1.0:
-                # a pure function of (seed, site, call): replayable
-                mix = zlib.crc32(f"{self.seed}:{site}:{call}".encode())
-                if np.random.RandomState(mix).random_sample() >= spec.p:
+        with self._lock:
+            call = self._calls.get(site, 0) + 1
+            self._calls[site] = call
+            for spec in specs:
+                if spec.nth and call != spec.nth:
                     continue
-            self.fired[f"{site}:{spec.kind}"] = self.fired.get(f"{site}:{spec.kind}", 0) + 1
-            return spec
-        return None
+                if spec.after and call <= spec.after:
+                    continue
+                if spec.times and call > spec.after + spec.times:
+                    continue
+                if spec.p < 1.0:
+                    # a pure function of (seed, site, call): replayable
+                    mix = zlib.crc32(f"{self.seed}:{site}:{call}".encode())
+                    if np.random.RandomState(mix).random_sample() >= spec.p:
+                        continue
+                self.fired[f"{site}:{spec.kind}"] = self.fired.get(f"{site}:{spec.kind}", 0) + 1
+                return spec
+            return None
 
     def fire(self, site: str) -> Optional[str]:
         """The seam entry point. Returns the fault kind that fired (None for
@@ -225,7 +233,8 @@ class FaultInjector:
         return blob
 
     def stats(self) -> Dict[str, int]:
-        return dict(self.fired)
+        with self._lock:
+            return dict(self.fired)
 
 
 #: Shared inert instance for default arguments — ``fire()`` on it is a single

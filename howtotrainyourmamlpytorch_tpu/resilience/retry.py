@@ -27,9 +27,12 @@ def backoff_schedule(
 ) -> Tuple[float, ...]:
     """The delays ``retry_call`` would sleep between attempts: exponential
     doubling from ``backoff_s`` capped at ``max_backoff_s``, each inflated by
-    up to ``jitter`` fraction (seeded rng -> deterministic in tests). Exposed
-    separately so callers can budget deadlines against it."""
-    rng = rng or np.random.RandomState(0)
+    up to ``jitter`` fraction (pass a seeded rng for determinism in tests).
+    Exposed separately so callers can budget deadlines against it. The
+    default rng is OS-seeded: jitter exists to DEcorrelate the retries of
+    many threads/processes hitting the same blip, so they must not all draw
+    the identical inflation sequence."""
+    rng = rng if rng is not None else np.random.RandomState()
     delays = []
     for attempt in range(retries):
         base = min(backoff_s * (2.0 ** attempt), max_backoff_s)

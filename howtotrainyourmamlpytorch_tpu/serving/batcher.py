@@ -58,6 +58,10 @@ class MicroBatcher:
         self.shed = 0  # submits refused at max_queue_depth
         self.flushes_full = 0
         self.flushes_deadline = 0
+        # flushes whose flush_fn RETURNED (result or exception) — the
+        # worker-progress signal server._dispatch uses to tell a backlogged
+        # worker from a wedged one when a queued request's deadline expires
+        self.flushes_done = 0
         self.batched_requests = 0  # requests that shared a flush with others
         self._worker = threading.Thread(
             target=self._run, name=f"{name}-flush", daemon=True
@@ -93,6 +97,10 @@ class MicroBatcher:
         with self._lock:
             return sum(len(g) for g in self._groups.values())
 
+    def flushes_completed(self) -> int:
+        with self._lock:
+            return self.flushes_done
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             flushes = self.flushes_full + self.flushes_deadline
@@ -102,6 +110,7 @@ class MicroBatcher:
                 "flushes": flushes,
                 "flushes_full": self.flushes_full,
                 "flushes_deadline": self.flushes_deadline,
+                "flushes_done": self.flushes_done,
                 "batched_requests": self.batched_requests,
                 "mean_batch": (self.requests / flushes) if flushes else 0.0,
                 "queue_depth": sum(len(g) for g in self._groups.values()),
@@ -185,9 +194,13 @@ class MicroBatcher:
                         f"for {len(group)} payloads"
                     )
             except BaseException as exc:  # noqa: BLE001 — fail the futures, keep serving
+                with self._lock:
+                    self.flushes_done += 1  # an exception is still progress
                 for _, fut, _ in group:
                     self._complete(fut, exc=exc)
                 continue
+            with self._lock:
+                self.flushes_done += 1
             for (_, fut, _), res in zip(group, results):
                 self._complete(fut, result=res)
 

@@ -75,6 +75,7 @@ class ServingFrontend:
             failure_threshold=self.resilience.breaker_failure_threshold,
             cooldown_s=self.resilience.breaker_cooldown_s,
             half_open_probes=self.resilience.breaker_half_open_probes,
+            timeout_threshold=self.resilience.breaker_timeout_threshold,
             clock=clock,
         )
         self._adapt_batcher = MicroBatcher(
@@ -103,23 +104,31 @@ class ServingFrontend:
         """One guarded device dispatch: circuit breaker (fail fast while the
         device path is known-bad), queue-depth shed (bounded tail latency),
         per-request deadline (no caller waits forever on a wedged device).
-        Dispatch failures/successes feed the breaker; client-side refusals
-        (shed, breaker-open, deadline) deliberately do not — they say nothing
-        about device health."""
+        Dispatch failures/successes feed the breaker, and so do deadline
+        timeouts that look like a hang (zero flushes completed across the
+        whole wait) — under their own (breaker_timeout_threshold) streak,
+        since a wedged backend never raises. Pure client-side refusals
+        (shed, breaker-open, deadline expiry on a worker that is visibly
+        making progress) do not — they say nothing about device health."""
         res = self.resilience
-        if not self.breaker.allow():
+        permit = self.breaker.allow()
+        if permit is None:
             self.counters.inc("breaker_rejected")
             raise ServiceUnavailableError(
                 f"engine circuit breaker {self.breaker.state}; retry after "
                 f"cooldown",
                 retry_after_s=res.breaker_cooldown_s,
             )
+        # worker-progress mark, read BEFORE submit: any flush completing
+        # while we wait counts as progress when attributing a timeout below
+        progress_mark = batcher.flushes_completed()
         try:
             fut = batcher.submit(bucket, payload)
         except QueueFullError as exc:
             # never dispatched: a half-open probe slot this call consumed
-            # must be returned or the breaker wedges in half_open
-            self.breaker.release_probe()
+            # must be returned or the breaker wedges in half_open (the permit
+            # makes this a no-op unless this exact call took the slot)
+            self.breaker.release_probe(permit)
             self.counters.inc("shed")
             raise ServiceUnavailableError(
                 str(exc), retry_after_s=res.shed_retry_after_s
@@ -128,19 +137,29 @@ class ServingFrontend:
             result = fut.result(timeout=res.request_deadline_s)
         except concurrent.futures.TimeoutError as exc:
             fut.cancel()  # drop it if still queued; a racing flush is harmless
-            # outcome unknown (the flush may still land): return the probe
-            # slot so the next request can probe again rather than the
-            # breaker staying half_open with zero slots forever
-            self.breaker.release_probe()
+            # attribute the expiry before feeding the breaker. The worker
+            # completing ANY flush while we waited means the device is
+            # making progress and this expiry is queue-wait (or a one-off
+            # slow dispatch) on a busy device — overload evidence, not
+            # wedge evidence, so only the probe slot (if any) is returned.
+            # Zero flushes completed across the whole deadline is the hang
+            # signature: a timed-out probe re-opens the breaker (its slot
+            # is reclaimed by the trip), and repeated closed-state timeouts
+            # trip it at breaker_timeout_threshold.
+            if batcher.flushes_completed() != progress_mark:
+                self.breaker.release_probe(permit)
+                self.counters.inc("queue_wait_expired")
+            else:
+                self.breaker.record_timeout(permit)
             self.counters.inc("deadline_exceeded")
             raise DeadlineExceededError(
                 f"request exceeded the {res.request_deadline_s}s deadline"
             ) from exc
         except Exception:
             self.counters.inc("dispatch_failures")
-            self.breaker.record_failure()
+            self.breaker.record_failure(permit)
             raise
-        self.breaker.record_success()
+        self.breaker.record_success(permit)
         return result
 
     def adapt(self, x_support, y_support) -> Dict[str, Any]:
@@ -186,8 +205,11 @@ class ServingFrontend:
     def healthz(self) -> Dict[str, Any]:
         # degraded = serving, but in a mode a load balancer / operator should
         # react to: the engine breaker is open (device dispatch failing) or
-        # half-open (probing). The HTTP layer returns 503 for degraded so
-        # orchestrators drain traffic away; OPERATIONS.md "Degraded modes".
+        # half-open (probing). The HTTP layer returns 503 only while OPEN so
+        # orchestrators drain traffic away; half-open stays 200 (body still
+        # says degraded) because the breaker can only close via real requests
+        # passing as probes — a drained backend would stay degraded forever.
+        # OPERATIONS.md "Degraded modes".
         breaker_state = self.breaker.state
         degraded = [] if breaker_state == "closed" else [f"breaker_{breaker_state}"]
         return {
@@ -267,9 +289,13 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/healthz":
                 health = frontend.healthz()
-                # 503 on degraded so load balancers drain without parsing
-                # the body; the body still says exactly what is degraded
-                self._send_json(200 if health["status"] == "ok" else 503, health)
+                # 503 only while the breaker is OPEN, so load balancers
+                # drain without parsing the body; half-open must keep
+                # receiving traffic (probes are real requests) or the
+                # breaker could never close — the body still says exactly
+                # what is degraded
+                code = 503 if "breaker_open" in health["degraded"] else 200
+                self._send_json(code, health)
             elif self.path == "/metrics":
                 self._send_json(200, frontend.metrics())
             else:
@@ -280,9 +306,12 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802
         frontend: ServingFrontend = self.server.frontend  # type: ignore[attr-defined]
         try:
-            # fault seam for handler-level drills (raise -> 500, delay)
-            frontend.engine.injector.fire("serving.http")
+            # fault seam for handler-level drills (raise -> 500, delay) —
+            # fired AFTER the body is drained so an injected 500 on a
+            # keep-alive connection doesn't leave unread body bytes to be
+            # misparsed as the client's next request
             req = self._read_json()
+            frontend.engine.injector.fire("serving.http")
             if self.path == "/adapt":
                 out = frontend.adapt(req["x_support"], req["y_support"])
                 self._send_json(200, out)

@@ -191,22 +191,115 @@ def test_circuit_breaker_state_machine_fake_clock():
 
 def test_breaker_released_probe_slot_is_not_leaked():
     """Regression: a half-open probe whose call never resolves (shed before
-    dispatch / deadline timeout) must return its slot — otherwise the breaker
-    wedges in half_open rejecting everything forever."""
+    dispatch) must return its slot — otherwise the breaker wedges in
+    half_open rejecting everything forever."""
     t = {"now": 0.0}
     b = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, half_open_probes=1,
                        clock=lambda: t["now"])
     b.record_failure()
     t["now"] = 6.0
-    assert b.allow()  # the only probe slot, consumed
+    probe = b.allow()  # the only probe slot, consumed
+    assert probe and probe.probe
     assert not b.allow()  # wedged without release...
-    b.release_probe()  # ...the unresolved call gives it back
+    b.release_probe(probe)  # ...the unresolved call gives it back
     assert b.allow()
     b.record_success()
     assert b.state == "closed"
-    # no-op outside half-open
-    b.release_probe()
+    # a closed-state permit is a no-op to release
+    permit = b.allow()
+    assert permit and not permit.probe
+    b.release_probe(permit)
     assert b.state == "closed" and b.allow()
+
+
+def test_breaker_stale_permit_cannot_release_anothers_probe_slot():
+    """Regression: a call admitted while closed whose breaker trips and
+    half-opens before it resolves must not, on its late shed/timeout, free
+    the probe slot a different in-flight probe owns — half_open_probes is a
+    concurrency bound, not a suggestion."""
+    t = {"now": 0.0}
+    b = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, half_open_probes=1,
+                       clock=lambda: t["now"])
+    stale = b.allow()  # admitted while closed; call still in flight
+    assert stale and not stale.probe
+    b.record_failure()  # another call's failure trips the breaker
+    t["now"] = 6.0
+    probe = b.allow()  # a probe takes the only half-open slot
+    assert probe and probe.probe
+    b.release_probe(stale)  # the old closed-era call sheds late
+    assert not b.allow()  # slot NOT freed: still exactly one probe in flight
+    # a probe permit from an earlier half-open generation is just as inert
+    b.record_failure()  # the probe fails -> re-open
+    t["now"] = 12.0
+    probe2 = b.allow()
+    assert probe2 and probe2.generation != probe.generation
+    b.release_probe(probe)  # stale generation: no-op
+    assert not b.allow()
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_stale_verdicts_cannot_move_half_open_probe_state():
+    """A closed-era call whose dispatch finally resolves — lands (success) or
+    raises (failure) — after the breaker has tripped and half-opened must not
+    close or re-open the breaker: only the in-flight probe's own verdict (or
+    a permitless manual verdict) moves the half-open state machine."""
+    t = {"now": 0.0}
+    b = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, half_open_probes=1,
+                       clock=lambda: t["now"])
+    stale = b.allow()  # admitted while closed; resolves much later
+    assert stale and not stale.probe
+    b.record_failure()  # another call trips the breaker
+    t["now"] = 6.0
+    probe = b.allow()  # the genuine probe, still in flight
+    assert probe and probe.probe
+    # the old call's late success must not close the breaker onto a device
+    # the probe hasn't vouched for...
+    b.record_success(stale)
+    assert b.state == "half_open"
+    # ...and its late failure must not re-open it, discarding the probe
+    b.record_failure(stale)
+    assert b.state == "half_open" and b.opens == 1
+    # a stale timeout is lifetime-counted only: no trip, no phantom streak
+    b.record_timeout(stale)
+    assert b.state == "half_open"
+    assert b.snapshot()["consecutive_timeouts"] == 0
+    # the probe's own verdict still drives the transition
+    b.record_success(probe)
+    assert b.state == "closed"
+
+
+def test_breaker_timeouts_trip_under_their_own_threshold():
+    """A hung backend never raises, so record_failure never fires — repeated
+    deadline timeouts must trip the breaker through their own (separate,
+    consecutive) threshold, and a hung half-open probe must re-open it."""
+    t = {"now": 0.0}
+    b = CircuitBreaker(failure_threshold=5, timeout_threshold=3, cooldown_s=10.0,
+                       half_open_probes=1, clock=lambda: t["now"])
+    # a success breaks the streak: 2 timeouts + success + 2 timeouts = closed
+    for _ in range(2):
+        b.record_timeout(b.allow())
+    b.allow()
+    b.record_success()
+    for _ in range(2):
+        b.record_timeout(b.allow())
+    assert b.state == "closed"
+    # the 3rd consecutive timeout trips it
+    b.record_timeout(b.allow())
+    assert b.state == "open" and b.opens == 1
+    snap = b.snapshot()
+    assert snap["timeouts"] == 5 and snap["consecutive_timeouts"] == 0
+    # a probe that hangs re-opens immediately — the device is still wedged
+    t["now"] = 11.0
+    probe = b.allow()
+    assert probe and probe.probe
+    b.record_timeout(probe)
+    assert b.state == "open" and b.opens == 2
+    # recovery: cooldown -> probe succeeds -> closed
+    t["now"] = 22.0
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed"
 
 
 # ---------------------------------------------------------------------------
@@ -330,6 +423,38 @@ def test_nan_step_skipped_then_rollback_with_lr_backoff(toy_dataset, tmp_path):
     rows = load_statistics(os.path.join(runner.run_dir, "logs"))
     assert len(rows) == cfg.total_epochs
     assert np.isfinite(float(rows[0]["train_loss_mean"]))
+
+
+def test_isolated_nan_steps_do_not_accumulate_to_rollback(toy_dataset, tmp_path):
+    """Regression: the K threshold counts CONSECUTIVE discards — the streak
+    resets on every settled-good step. Isolated non-finite steps with healthy
+    steps between them (here 3 of them, K=2) must be skipped individually and
+    never add up to a rollback, an LR backoff, or (once the rollback budget
+    is spent) a spurious rc=3 abort of a healthy run."""
+    cfg = runner_config(
+        toy_dataset, tmp_path, experiment_name="toy_nan_isolated",
+        total_iter_per_epoch=5,
+        resilience=ResilienceConfig(
+            # poisoned dispatches 3 apart: a bad settle also discards the one
+            # in-flight dispatch built on the poisoned state, so two healthy
+            # dispatches between NaNs guarantee a settled-GOOD step between
+            # every pair of discards
+            faults=["runner.step=nan-loss:nth=1",
+                    "runner.step=nan-loss:nth=4",
+                    "runner.step=nan-loss:nth=7"],
+            max_consecutive_bad_steps=2,
+            max_rollbacks=2,
+        ),
+    )
+    system = small_system(cfg)
+    runner = ExperimentRunner(cfg, system=system)
+    result = runner.run_experiment()
+    assert "test_accuracy_mean" in result
+    events = [e.get("event") for e in _events(runner.run_dir)]
+    assert events.count("nan_step_skipped") == 3
+    assert "nan_rollback" not in events and "nan_abort" not in events
+    # no rollback -> the outer LR schedule was never backed off
+    assert system.meta_lr_scale == pytest.approx(1.0)
 
 
 def test_nan_abort_rc3_after_failed_rollbacks(toy_dataset, tmp_path):
@@ -637,16 +762,85 @@ def test_request_deadline_maps_to_gateway_timeout():
         with pytest.raises(DeadlineExceededError):
             frontend.adapt(*_support(6))
         assert frontend.counters.get("deadline_exceeded") == 1
-        # a deadline miss says nothing about device health: breaker untouched
+        # one miss is counted toward the breaker's timeout streak but stays
+        # below breaker_timeout_threshold: the breaker remains closed
         assert frontend.breaker.state == "closed"
+        assert frontend.breaker.snapshot()["timeouts"] == 1
     finally:
+        frontend.close()
+
+
+def test_hung_dispatch_trips_breaker_to_fast_503():
+    """A wedged backend (hangs, never raises) must open the breaker after
+    breaker_timeout_threshold consecutive deadline misses, converting
+    full-deadline 504s into immediate 503s."""
+    inj = FaultInjector.from_specs(
+        ["serving.dispatch=delay:delay_s=0.25,times=2"], include_env=False
+    )
+    engine = _tiny_engine(injector=inj)
+    res = ResilienceConfig(
+        request_deadline_s=0.01, breaker_timeout_threshold=2,
+        breaker_failure_threshold=5, breaker_cooldown_s=60.0,
+    )
+    frontend = ServingFrontend(engine, resilience_cfg=res)
+    try:
+        for seed in (7, 8):
+            with pytest.raises(DeadlineExceededError):
+                frontend.adapt(*_support(seed))
+        assert frontend.breaker.state == "open"
+        assert frontend.breaker.snapshot()["timeouts"] == 2
+        # the next request is refused immediately, not after the deadline
+        with pytest.raises(ServiceUnavailableError):
+            frontend.adapt(*_support(9))
+        assert frontend.counters.get("breaker_rejected") == 1
+        assert frontend.healthz()["status"] == "degraded"
+    finally:
+        frontend.close()
+
+
+def test_queue_wait_expiry_on_progressing_worker_is_not_hang_evidence():
+    """A request whose deadline expires behind a worker that completed
+    flushes during the wait is overload on a healthy device — it must not
+    feed the breaker's wedge streak. With breaker_timeout_threshold=1 this
+    is sharp: one wedge-attributed timeout would trip the breaker, so it
+    staying closed proves the attribution."""
+    engine = _tiny_engine()
+    res = ResilienceConfig(request_deadline_s=0.2, breaker_timeout_threshold=1)
+    frontend = ServingFrontend(engine, resilience_cfg=res)
+    entered = threading.Event()
+    gate = threading.Semaphore(0)
+
+    def flush(bucket, payloads):
+        entered.set()
+        gate.acquire()
+        return payloads
+
+    slow = MicroBatcher(flush, max_batch=1, deadline_ms=0, name="slow")
+    try:
+        slow.submit("k1", "A")  # worker parks inside flush A
+        assert entered.wait(5.0)
+        slow.submit("k1", "A2")  # keeps the worker busy after A completes
+        # mid-wait, let flush A complete: the worker makes progress (and
+        # immediately parks in flush A2), with B still queued in its bucket
+        threading.Timer(0.05, gate.release).start()
+        with pytest.raises(DeadlineExceededError):
+            frontend._dispatch(slow, "k2", "B")
+        assert frontend.counters.get("deadline_exceeded") == 1
+        assert frontend.counters.get("queue_wait_expired") == 1
+        # progress observed -> released, not recorded: breaker untouched
+        assert frontend.breaker.state == "closed"
+        assert frontend.breaker.snapshot()["timeouts"] == 0
+    finally:
+        gate.release(), gate.release(), gate.release()
+        slow.close()
         frontend.close()
 
 
 def test_healthz_degraded_returns_503_over_http():
     engine = _tiny_engine()
     res = ResilienceConfig(breaker_failure_threshold=1, breaker_cooldown_s=60.0)
-    frontend = ServingFrontend(engine, resilience_cfg=res)
+    clock = {"now": 0.0}
+    frontend = ServingFrontend(engine, resilience_cfg=res, clock=lambda: clock["now"])
     frontend.breaker.record_failure()  # trip it directly
     server = make_http_server(frontend, "127.0.0.1", 0)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
@@ -659,6 +853,14 @@ def test_healthz_degraded_returns_503_over_http():
         body = json.loads(exc.value.read())
         assert body["status"] == "degraded"
         assert body["degraded"] == ["breaker_open"]
+        # half-open must NOT 503: the breaker closes only via real requests
+        # passing as probes, so a drained backend would never recover
+        clock["now"] = 61.0
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        assert body["status"] == "degraded"
+        assert body["degraded"] == ["breaker_half_open"]
     finally:
         server.shutdown()
         server.server_close()
