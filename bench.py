@@ -30,7 +30,7 @@ import time
 os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 
 REFERENCE_STEPS_PER_SEC = 2.6  # fastest plausible single-GPU reference (see docstring)
-STARTUP_TIMEOUT_S = 90.0
+STARTUP_TIMEOUT_S = float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", 90.0))
 # The axon tunnel wedges for minutes-to-hours at a time (server-side). A
 # single in-process init attempt cannot be retried (backend init happens once
 # per process), so before touching the backend in-process we wait for it with
@@ -73,20 +73,34 @@ def _wait_for_backend(deadline_s: float) -> None:
     gate (scripts/wait_for_tpu.py) — notably, jax's silent CPU fallback does
     NOT count unless BENCH_ALLOW_CPU=1, because benching the 20-way
     second-order program on one CPU core is a garbage number against a
-    per-chip baseline. Falls through after the deadline and lets the
-    in-process contact produce the structured failure."""
+    per-chip baseline.
+
+    Give-up handling differs by mode: K *consecutive hung probes* (the
+    dead-tunnel signature — BENCH_r05 burned ~30 min re-probing one 15
+    times) emits the structured-failure JSON line IMMEDIATELY and exits;
+    a mixed-failure deadline expiry falls through and lets the in-process
+    contact produce the structured failure, as before."""
     sys.path.insert(
         0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
     )
     from wait_for_tpu import wait_for_backend
 
-    wait_for_backend(
+    max_wedged = int(os.environ.get("BENCH_MAX_WEDGED_PROBES", "5"))
+    status = wait_for_backend(
         deadline_s,
         STARTUP_TIMEOUT_S,
         allow_cpu=os.environ.get("BENCH_ALLOW_CPU") == "1",
         label="bench",
         log=lambda m: print(m, file=sys.stderr, flush=True),
+        max_consecutive_wedged=max_wedged,
+        probe_interval_s=float(os.environ.get("BENCH_PROBE_INTERVAL_S", "30")),
     )
+    if status == "wedged":
+        _fail(
+            f"tunnel wedged: {max_wedged} consecutive backend probes hung "
+            f">{STARTUP_TIMEOUT_S:.0f}s each — giving up without an "
+            "in-process contact attempt (set BENCH_MAX_WEDGED_PROBES to tune)"
+        )
 
 
 def _contact_device():

@@ -136,6 +136,52 @@ class ServingConfig:
 
 
 @dataclass
+class WatchdogConfig:
+    """Hang (wedge) supervisor knobs (``resilience/watchdog.py``). A device
+    call that hangs instead of raising is invisible to every raise-based
+    defense; the watchdog converts a zero-progress interval into thread-stack
+    forensics + an emergency checkpoint + the distinct restartable exit code
+    ``wedge_exit_code`` (76), which ``scripts/sweep.sh`` treats as
+    restart-not-fail alongside the preemption code 75."""
+
+    enabled: bool = True
+    # zero-progress seconds before the runner is declared wedged. Progress =
+    # a dispatched/settled train step, an eval batch, a checkpoint write —
+    # so the budget must cover one XLA compile of the heaviest program
+    # (epoch 0 of the 20-way configs runs minutes of compile on a cold
+    # cache; sweep.sh's log-staleness kill uses 420s against coarser
+    # evidence). Generous by default; drills override it down.
+    deadline_s: float = 900.0
+    # supervisor poll period; 0 = auto (deadline/10 clamped to [0.02s, 5s])
+    poll_s: float = 0.0
+    wedge_exit_code: int = 76
+    # serving-side supervision of the batcher flush workers: a flush that
+    # hangs in device dispatch past serve_deadline_s with work queued behind
+    # it exits wedge_exit_code so a supervisor restarts the server (the
+    # breaker already fail-fasts *clients*; it cannot un-hang the worker).
+    serve_enabled: bool = True
+    serve_deadline_s: float = 600.0
+
+    def __post_init__(self):
+        if self.deadline_s <= 0:
+            raise ValueError(
+                f"resilience.watchdog.deadline_s must be > 0, got {self.deadline_s}"
+            )
+        if self.serve_deadline_s <= 0:
+            raise ValueError(
+                f"resilience.watchdog.serve_deadline_s must be > 0, "
+                f"got {self.serve_deadline_s}"
+            )
+        if not 1 <= self.wedge_exit_code <= 125 or self.wedge_exit_code in (3, 75):
+            # 3 = permanent divergence, 75 = preemption: reusing either would
+            # make the sweep misclassify a wedge
+            raise ValueError(
+                "resilience.watchdog.wedge_exit_code must be in [1, 125] and "
+                f"distinct from 3/75, got {self.wedge_exit_code}"
+            )
+
+
+@dataclass
 class ResilienceConfig:
     """Fault tolerance knobs (resilience/ package; no reference equivalent —
     the reference crashes on the first NaN, corrupt checkpoint, or SIGKILL).
@@ -184,12 +230,17 @@ class ResilienceConfig:
     # request_deadline_s before the client hears anything, so a hung device
     # should go fast-503 after fewer events than instant raising failures
     breaker_timeout_threshold: int = 3
+    # --- wedge watchdog (resilience/watchdog.py) ---
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     # --- fault injection (resilience/faults.py; spec grammar documented
     # there; HTYMP_FAULTS env specs are merged in at injector build) ---
     faults: List[str] = field(default_factory=list)
     fault_seed: int = 0
 
     def __post_init__(self):
+        # YAML / dotlist loads hand the nested block over as a plain dict
+        if isinstance(self.watchdog, dict):
+            self.watchdog = WatchdogConfig(**self.watchdog)
         self.faults = list(self.faults)
         # parse eagerly so a typo'd drill spec fails at config load, not
         # hours into the run it was meant to harden

@@ -15,6 +15,7 @@ episode assembly).
 """
 
 import contextlib
+import dataclasses
 import os
 import signal
 import threading
@@ -32,11 +33,14 @@ from ..data.loader import _stack
 from ..parallel import (
     batch_sharding,
     chunk_sharding,
+    degraded_mesh_plan,
     global_batch_from_local,
     make_mesh,
+    requested_mesh_shape,
     shard_train_state,
 )
 from ..resilience.faults import injector_from
+from ..resilience.watchdog import HeartbeatWatchdog
 from ..utils.trees import named_leaves
 from . import checkpoint as ckpt
 from . import storage
@@ -84,6 +88,11 @@ class ExperimentRunner:
         save_config(cfg, os.path.join(self.run_dir, "config.yaml"))
         self.experiment_name = cfg.run_name()
         storage.create_json_experiment_log(self.logs_dir, self.experiment_name, cfg.to_dict())
+        # persistent events.jsonl handle: appends are flushed immediately and
+        # the handle is closed on every exit path (run_experiment finally;
+        # the wedge path closes it explicitly before os._exit skips finally)
+        # so post-mortems never lose the final events
+        self.events = storage.EventLog(self.logs_dir)
 
         # --- resilience (config.py::ResilienceConfig; resilience/ package) ---
         # fault injector (inert unless cfg.resilience.faults / HTYMP_FAULTS
@@ -156,11 +165,49 @@ class ExperimentRunner:
         )
         global_batch_size = cfg.batch_size * cfg.samples_per_iter
         self.mesh = None
-        if cfg.parallel.shard_meta_batch and len(jax.devices()) > 1:
-            mesh = make_mesh(cfg.parallel)
+        # elastic degraded resume: fewer visible devices than ParallelConfig
+        # demands (a chip died, a slice shrank across a maintenance event)
+        # used to be fatal at make_mesh. Instead compute the largest feasible
+        # shrunken mesh, reshard onto it, and keep training at reduced
+        # throughput — a lost device costs bandwidth, not the run.
+        self.degraded_mesh: Optional[Dict[str, Any]] = None
+        parallel = cfg.parallel
+        n_visible = len(jax.devices())
+        if parallel.shard_meta_batch:
+            plan = degraded_mesh_plan(parallel, n_visible, global_batch_size)
+            if plan is not None:
+                dp_req, mp_req = requested_mesh_shape(parallel, n_visible)
+                dp, mp = plan
+                parallel = dataclasses.replace(parallel, dp=dp, mp=mp)
+                self.degraded_mesh = {
+                    "requested": [dp_req, mp_req],
+                    "granted": [dp, mp],
+                    "visible_devices": n_visible,
+                }
+                msg = (
+                    f"DEGRADED MESH: config demands dp={dp_req} x mp={mp_req} "
+                    f"but only {n_visible} device(s) are visible — continuing "
+                    + (f"on a shrunken dp={dp} x mp={mp} mesh"
+                       if dp * mp > 1 else "on a single device")
+                    + " at reduced throughput"
+                )
+                print(msg, flush=True)
+                self.events.append(
+                    {"ts": time.time(), "event": "degraded_mesh", **self.degraded_mesh}
+                )
+                storage.change_json_log_experiment_status(
+                    self.logs_dir, self.experiment_name, msg
+                )
+        if parallel.shard_meta_batch and n_visible > 1 and (
+            self.degraded_mesh is None
+            or self.degraded_mesh["granted"] != [1, 1]
+        ):
+            mesh = make_mesh(parallel)
             if global_batch_size % mesh.shape["dp"] != 0:
                 # A silent fall-back to one device would be an 8x perf cliff on
-                # a pod slice — refuse instead (VERDICT r1 weak #4).
+                # a pod slice — refuse instead (VERDICT r1 weak #4). (A
+                # degraded plan always picks a dp dividing the batch, so this
+                # only fires on an explicitly misconfigured feasible mesh.)
                 raise ValueError(
                     f"meta-batch ({global_batch_size}) not divisible by dp="
                     f"{mesh.shape['dp']}: adjust batch_size/samples_per_iter "
@@ -171,7 +218,9 @@ class ExperimentRunner:
             # dp: replicated train state; dp x mp: tensor-parallel shardings
             # (dense-head kernel column-parallel over mp; conv kernels too
             # when parallel.tp_convs — rationale in
-            # parallel/mesh.py::_param_spec)
+            # parallel/mesh.py::_param_spec). On a degraded resume this is
+            # also where the restored TrainState is resharded onto the
+            # shrunken mesh.
             self.state = shard_train_state(
                 self.state, self.mesh, tp_convs=cfg.parallel.tp_convs
             )
@@ -212,6 +261,44 @@ class ExperimentRunner:
         # rollback anchor: the state as placed on device(s) right now — the
         # resumed checkpoint, or init. Refreshed on every epoch save.
         self._capture_last_good()
+        # bookkeeping matching _last_good, so the wedge watchdog can write a
+        # resumable emergency checkpoint from the last settled HOST state
+        # while the main thread hangs in a device call (it must never touch
+        # the device itself). Resume replays the wedged epoch from this
+        # anchor over the deterministic episode stream — exact, like the
+        # preemption path, at the cost of the wedged epoch's partial work.
+        # ONE tuple (state, bookkeeping) rebound atomically, so the watchdog
+        # thread can never pair a fresh state with stale bookkeeping (or
+        # vice versa) while _save is mid-update
+        self._wedge_anchor = (
+            self._last_good,
+            {
+                "epoch": self.start_epoch - 1,
+                "mid_epoch_iter": self._resume_mid_iter,
+                "train_episodes_produced": self.loader.train_episodes_produced,
+                "best_val_accuracy": self.best_val_accuracy,
+                "best_val_epoch": self.best_val_epoch,
+                "val_acc_by_epoch": {
+                    str(k): v for k, v in self.val_acc_by_epoch.items()
+                },
+            },
+        )
+
+        # --- wedge watchdog (resilience/watchdog.py) ----------------------
+        # armed for the duration of run_experiment; fed by per-step progress
+        # marks from the dispatch/settle loop, eval batches, and checkpoint
+        # writes. Zero progress past the deadline => thread stacks into
+        # events.jsonl, emergency checkpoint from _last_good, os._exit(76).
+        wd_cfg = cfg.resilience.watchdog
+        self._watchdog: Optional[HeartbeatWatchdog] = None
+        if wd_cfg.enabled:
+            self._watchdog = HeartbeatWatchdog(
+                deadline_s=wd_cfg.deadline_s,
+                poll_s=wd_cfg.poll_s,
+                on_wedge=self._on_wedge,
+                exit_code=wd_cfg.wedge_exit_code,
+                name="runner",
+            )
 
     # ------------------------------------------------------------------
 
@@ -260,6 +347,9 @@ class ExperimentRunner:
             state_before, loss_dev, acc_dev, forced = pending
             pending = None
             loss_host = np.atleast_1d(np.asarray(jax.device_get(loss_dev)))
+            # the fetch above is where a wedged device call hangs first —
+            # completing it is the strongest liveness evidence there is
+            self._beat(f"settle epoch {epoch}")
             if forced or not np.all(np.isfinite(loss_host)):
                 self.state = state_before
                 return False
@@ -290,6 +380,7 @@ class ExperimentRunner:
                 self.state, (chunk_losses, chunk_accs, chunk_lrs) = (
                     self.system.train_step_multi(self.state, put, epoch)
                 )
+                self._beat(f"dispatch epoch {epoch}")
                 lr = chunk_lrs[-1]
                 if not guard:
                     losses.append(chunk_losses)
@@ -320,6 +411,7 @@ class ExperimentRunner:
                 self.state, out = self.system.train_step(
                     self.state, self._put(batch), epoch=epoch
                 )
+                self._beat(f"dispatch epoch {epoch}")
                 if profile_this_epoch and it == prof_stop - 1:
                     out.loss.block_until_ready()
                     jax.profiler.stop_trace()
@@ -364,6 +456,72 @@ class ExperimentRunner:
     # resilience: NaN skip/rollback ladder + preemption (resilience/)
     # ------------------------------------------------------------------
 
+    def _beat(self, stage: str) -> None:
+        """Progress mark feeding the wedge watchdog (no-op when disabled)."""
+        if self._watchdog is not None:
+            self._watchdog.beat(stage)
+
+    def _on_wedge(self, info: Dict[str, Any]) -> None:
+        """Watchdog verdict: zero progress past the deadline — the main
+        thread is hung in an uninterruptible device call. Runs ON THE
+        WATCHDOG THREAD and must stay host-side: dump every thread's stack
+        for the post-mortem, write an emergency 'latest' checkpoint from the
+        last settled host state (the rollback anchor — the hung device state
+        is unreachable), and let the watchdog ``os._exit`` with the wedge
+        code. Each salvage step is independent: a failure in one must not
+        cost the others (the exit happens regardless)."""
+        code = self.cfg.resilience.watchdog.wedge_exit_code
+        msg = (
+            f"WEDGED: no progress for {info['stall_s']:.0f}s "
+            f"(deadline {info['deadline_s']:.0f}s) at stage {info['stage']!r} "
+            f"— emergency checkpoint from the last settled state, exiting "
+            f"{code} (restart to resume)"
+        )
+        print(msg, flush=True)
+        try:
+            self.events.append(
+                {
+                    "ts": time.time(),
+                    "event": "wedged",
+                    "stage": info["stage"],
+                    "stall_s": info["stall_s"],
+                    "beats": info["beats"],
+                    "threads": info["threads"],
+                }
+            )
+        except Exception:
+            pass
+        try:
+            anchor_state, anchor_book = self._wedge_anchor  # one atomic read
+            ckpt.save_named(
+                self.saved_models_dir,
+                anchor_state,
+                dict(anchor_book),
+                "latest",
+                injector=self._injector,
+            )
+            self.events.append(
+                {
+                    "ts": time.time(),
+                    "event": "wedge_checkpoint",
+                    "epoch": anchor_book.get("epoch"),
+                    "mid_epoch_iter": anchor_book.get("mid_epoch_iter"),
+                }
+            )
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+        try:
+            storage.change_json_log_experiment_status(
+                self.logs_dir, self.experiment_name, msg
+            )
+        except Exception:
+            pass
+        # os._exit skips finally blocks: close the event log here or the
+        # post-mortem loses its own final lines
+        self.events.close()
+
     def _place_state(self, host_state: TrainState) -> TrainState:
         """Host pytree -> device state with the run's shardings."""
         if self.mesh is not None:
@@ -385,8 +543,7 @@ class ExperimentRunner:
         stream into the same state would reproduce the same NaN."""
         res = self.cfg.resilience
         self._bad_steps += 1
-        storage.append_jsonl(
-            self.logs_dir,
+        self.events.append(
             {
                 "ts": time.time(),
                 "event": "nan_step_skipped",
@@ -407,8 +564,8 @@ class ExperimentRunner:
                 f"after {self._rollbacks} rollbacks — unrecoverable"
             )
             print(msg, flush=True)
-            storage.append_jsonl(
-                self.logs_dir, {"ts": time.time(), "event": "nan_abort", "epoch": epoch}
+            self.events.append(
+                {"ts": time.time(), "event": "nan_abort", "epoch": epoch}
             )
             storage.change_json_log_experiment_status(
                 self.logs_dir, self.experiment_name, msg
@@ -418,8 +575,7 @@ class ExperimentRunner:
         self._bad_steps = 0
         self.state = self._place_state(self._last_good)
         self.system.scale_meta_lr(res.rollback_lr_backoff)
-        storage.append_jsonl(
-            self.logs_dir,
+        self.events.append(
             {
                 "ts": time.time(),
                 "event": "nan_rollback",
@@ -500,9 +656,8 @@ class ExperimentRunner:
             f"{cfg.resilience.preemption_exit_code} (restart to resume)"
         )
         print(msg, flush=True)
-        storage.append_jsonl(
-            self.logs_dir,
-            {"ts": time.time(), "event": "preempted", "epoch": epoch, "iter": mid},
+        self.events.append(
+            {"ts": time.time(), "event": "preempted", "epoch": epoch, "iter": mid}
         )
         storage.change_json_log_experiment_status(
             self.logs_dir, self.experiment_name, msg
@@ -534,6 +689,7 @@ class ExperimentRunner:
         ep_losses, ep_accs = [], []
         for batch in batches:
             out = self.system.eval_step(self.state, self._put(batch))
+            self._beat(f"eval {split}")
             ep_losses.append(out.per_task_losses)
             ep_accs.append(out.per_task_accuracies)
         if self._multihost:
@@ -590,8 +746,11 @@ class ExperimentRunner:
             ),
             injector=self._injector,
         )
-        # this durable state is the new NaN-rollback anchor
+        # this durable state is the new NaN-rollback anchor, and (with its
+        # bookkeeping) the wedge watchdog's emergency-checkpoint anchor
         self._last_good = host_state
+        self._wedge_anchor = (host_state, {**bookkeeping, "mid_epoch_iter": 0})
+        self._beat(f"checkpoint epoch {epoch}")
 
     def _save_best(self) -> None:
         ckpt.save_named(
@@ -635,6 +794,7 @@ class ExperimentRunner:
         probs = []
         for batch in batches:
             out = self.system.eval_step(state, self._put(batch))
+            self._beat("eval test-ensemble")
             probs.append(self._gather_array(jax.nn.softmax(out.per_task_target_logits, axis=-1)))
         return probs
 
@@ -704,11 +864,21 @@ class ExperimentRunner:
         abort, the preemption SystemExit, and errors — so back-to-back runs
         in one process (sweeps, tests) don't accumulate leaked episode-pool
         threads. SIGTERM/SIGINT during the run trigger the emergency-save
-        path (resilience.preemption_save)."""
+        path (resilience.preemption_save); the wedge watchdog is armed for
+        exactly this scope and fed by the per-step progress marks."""
         try:
             with self._preemption_guard():
+                if self._watchdog is not None:
+                    with self._watchdog.watching("run_experiment"):
+                        return self._run_experiment()
                 return self._run_experiment()
         finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+            # flush + close events.jsonl on every non-wedge exit path
+            # (normal, rc=3 abort, rc=75 preemption, errors); the rc=76
+            # wedge path closes it itself before os._exit
+            self.events.close()
             if self._owns_loader:
                 self.loader.close()
 
@@ -727,7 +897,7 @@ class ExperimentRunner:
             storage.update_json_experiment_log_epoch_stats(
                 self.logs_dir, self.experiment_name, epoch, stats
             )
-            storage.append_jsonl(self.logs_dir, {"ts": time.time(), **stats})
+            self.events.append({"ts": time.time(), **stats})
             self.write_inner_opt_stats()
             self.val_acc_by_epoch[epoch] = float(stats["val_accuracy_mean"])
             if stats["val_accuracy_mean"] > self.best_val_accuracy:
@@ -746,8 +916,7 @@ class ExperimentRunner:
                     f"already written, exiting {code} (restart to resume)",
                     flush=True,
                 )
-                storage.append_jsonl(
-                    self.logs_dir,
+                self.events.append(
                     {"ts": time.time(), "event": "preempted", "epoch": epoch},
                 )
                 raise SystemExit(code)
@@ -776,8 +945,8 @@ class ExperimentRunner:
                     f"(early_abort_epoch {cfg.early_abort_epoch}) — diverged"
                 )
                 print(msg, flush=True)
-                storage.append_jsonl(
-                    self.logs_dir, {"ts": time.time(), "event": "early_abort", **stats}
+                self.events.append(
+                    {"ts": time.time(), "event": "early_abort", **stats}
                 )
                 storage.change_json_log_experiment_status(
                     self.logs_dir, self.experiment_name, msg

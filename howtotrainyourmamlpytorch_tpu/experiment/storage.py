@@ -14,6 +14,7 @@ reference lacks (SURVEY.md §5.5).
 import csv
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -124,6 +125,43 @@ def change_json_log_experiment_status(log_dir: str, experiment_name: str, status
         json.dump(summary, f, indent=1)
 
 
-def append_jsonl(log_dir: str, record: Dict[str, Any], filename: str = "events.jsonl") -> None:
-    with open(os.path.join(log_dir, filename), "a") as f:
-        f.write(json.dumps(record) + "\n")
+class EventLog:
+    """Persistent ``events.jsonl`` handle for one run.
+
+    Post-mortems (wedge stack dumps, preemption events) are read precisely
+    when the process died ugly, so durability beats buffering: every append
+    is written whole and flushed under a lock (the wedge watchdog appends
+    from its own thread while the main thread hangs), and the runner closes
+    the handle on every exit path — normal completion, the rc=3 divergence
+    abort, the rc=75 preemption exit, and the rc=76 wedge ``os._exit`` (which
+    skips ``finally`` blocks, so the wedge path closes explicitly first).
+    ``close`` is idempotent; appending after close falls back to an
+    open-append-close so a late event is never silently dropped."""
+
+    def __init__(self, log_dir: str, filename: str = "events.jsonl"):
+        self.path = os.path.join(log_dir, filename)
+        self._lock = threading.Lock()
+        self._handle = None
+        self._closed = False
+
+    def append(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record) + "\n"
+        with self._lock:
+            if self._closed:
+                with open(self.path, "a") as f:
+                    f.write(line)
+                return
+            if self._handle is None:
+                self._handle = open(self.path, "a")
+            self._handle.write(line)
+            self._handle.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._handle is not None:
+                try:
+                    self._handle.flush()
+                    self._handle.close()
+                finally:
+                    self._handle = None

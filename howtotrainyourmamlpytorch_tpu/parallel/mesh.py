@@ -38,6 +38,46 @@ def make_mesh(parallel: Optional[ParallelConfig] = None, devices=None) -> Mesh:
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
 
+def requested_mesh_shape(parallel: Optional[ParallelConfig], n_devices: int):
+    """The ``(dp, mp)`` the config demands given ``n_devices`` visible
+    (``dp=-1`` auto-sizes to the visible devices, so it can never be
+    infeasible by itself; an explicit dp can)."""
+    parallel = parallel or ParallelConfig()
+    mp = max(parallel.mp, 1)
+    dp = parallel.dp if parallel.dp and parallel.dp > 0 else max(n_devices // mp, 1)
+    return dp, mp
+
+
+def degraded_mesh_plan(
+    parallel: Optional[ParallelConfig], n_devices: int, global_batch_size: int
+):
+    """Shrink plan for resuming on fewer devices than ``ParallelConfig``
+    demands — the device-loss half of the wedge-and-shrink failure class: a
+    TPU slice comes back from maintenance with a dead chip and the demanded
+    ``dp x mp`` no longer fits, which used to kill the run at ``make_mesh``.
+
+    Returns ``None`` when the demanded shape fits, else ``(dp, mp)`` of the
+    largest feasible degraded mesh: ``mp`` is kept if it still fits (model
+    sharding is a memory requirement, not a preference), else collapsed to 1;
+    ``dp`` drops to the largest value that (a) fits beside ``mp`` and (b)
+    divides the global meta-batch, so the existing divisibility contract
+    holds without reshaping the batch. ``(1, 1)`` means single-device
+    fallback (the caller skips the mesh entirely). Training continues at
+    reduced throughput; the math is unchanged — the meta-objective is a mean
+    over the task axis, and resharding only re-places the same arrays."""
+    dp_req, mp_req = requested_mesh_shape(parallel, n_devices)
+    if dp_req * mp_req <= n_devices:
+        return None
+    mp = mp_req if mp_req <= n_devices else 1
+    budget = max(n_devices // mp, 1)
+    dp = 1
+    for cand in range(min(budget, dp_req), 0, -1):
+        if global_batch_size % cand == 0:
+            dp = cand
+            break
+    return dp, mp
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Tasks of the meta-batch sharded over dp; everything else replicated."""
     return NamedSharding(mesh, P(DATA_AXIS))

@@ -13,6 +13,13 @@ under load. This package is the shared machinery that makes both promises
   injectable clock/sleep (loader transient-I/O retries, client helpers).
 - :mod:`breaker` — ``CircuitBreaker``: closed/open/half-open around the
   serving engine's device dispatch.
+- :mod:`watchdog` — ``HeartbeatWatchdog``: the hang (wedge) supervisor —
+  zero progress past a deadline becomes thread-stack forensics, an
+  emergency checkpoint, and the restartable exit code 76 instead of a
+  process that sleeps forever in a device call.
+- :mod:`campaign` — the seeded chaos-soak runner (``scripts/chaos_soak.py``)
+  that walks every fault seam through short episodes and checks the
+  cross-cutting invariants after each.
 
 Consumers of the *policies* (NaN-step skip/rollback ladder, preemption-safe
 emergency checkpoints, checkpoint integrity + fallback, load shedding) live
@@ -31,3 +38,8 @@ from .faults import (  # noqa: F401
     injector_from,
 )
 from .retry import DeadlineExceededError, backoff_schedule, retry_call  # noqa: F401
+from .watchdog import (  # noqa: F401
+    WEDGE_EXIT_CODE,
+    HeartbeatWatchdog,
+    dump_all_thread_stacks,
+)

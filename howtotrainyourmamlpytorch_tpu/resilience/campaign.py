@@ -1,0 +1,642 @@
+"""Seeded chaos-soak campaign: systematic coverage of the fault matrix.
+
+PR 2 proved each failure class survivable with hand-written drills; this
+module turns those drills into a *campaign*: a seeded sampler walks every
+registered fault seam (``resilience/faults.py``) across short train / resume
+/ serve episodes, and a fixed set of cross-cutting invariants is checked
+after every episode — the things that must hold no matter which fault fired:
+
+1. **rc discipline** — the episode exits one of the documented codes
+   (0 ok, 3 permanent divergence, 75 preemption, 76 wedged). Anything else
+   is an undocumented failure mode.
+2. **checkpoint availability** — if any checkpoint file exists,
+   ``load_latest_with_fallback`` must produce a loadable state (a corrupt
+   ``latest`` must leave a valid fallback, never a bricked run dir).
+3. **event-log integrity** — every line of ``logs/events.jsonl`` parses as
+   JSON (a torn post-mortem is a post-mortem you can't read).
+4. **serving honesty** — a request either succeeds with a well-formed
+   payload or fails with a documented error class / HTTP status; shedding,
+   breaker rejections and deadline expiries are never dressed up as 200s.
+
+The campaign is deterministic in ``seed``: the same seed replays the same
+episode sequence with the same fault triggers (the injector's own
+determinism does the rest). ``scripts/chaos_soak.py`` is the CLI; a fast
+fixed-seed smoke runs in tier-1 (``tests/test_chaos_smoke.py``) and the full
+soak rides behind ``-m slow``.
+
+Episodes marked ``subprocess`` fork a fresh interpreter because their
+verdict *is* the process exit code of an ``os._exit`` path (the rc=76 wedge)
+or requires a different visible-device count (degraded-mesh resume, which
+shrinks ``dp`` when devices disappear between runs). Everything else runs
+in-process for speed and compile-cache reuse.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+#: Exit codes with documented semantics (docs/OPERATIONS.md rc table):
+#: 0 = completed, 3 = permanent divergence (NaN ladder exhausted / early
+#: abort), 75 = preemption emergency checkpoint, 76 = wedge watchdog.
+DOCUMENTED_RCS = (0, 3, 75, 76)
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+# ---------------------------------------------------------------------------
+# toy workload (self-contained: no pytest fixtures)
+# ---------------------------------------------------------------------------
+
+
+def make_toy_dataset(root: str, seed: int = 0) -> str:
+    """A 20-class on-disk toy Omniglot (4 alphabets x 5 chars x 6 images) —
+    the same shape the test suite trains its miniature runs on, small enough
+    that an episode is seconds, real enough to exercise the full loader."""
+    from PIL import Image
+
+    if os.path.isdir(root) and os.listdir(root):
+        return root
+    rng = np.random.RandomState(seed)
+    for a in range(4):
+        for c in range(5):
+            d = os.path.join(root, f"alpha{a}", f"char{c}")
+            os.makedirs(d, exist_ok=True)
+            base = (rng.rand(28, 28) > 0.5).astype(np.uint8) * 255
+            for i in range(6):
+                noisy = base ^ (rng.rand(28, 28) > 0.95).astype(np.uint8) * 255
+                Image.fromarray(noisy, mode="L").convert("1").save(
+                    os.path.join(d, f"{i}.png")
+                )
+    return root
+
+
+def campaign_config(data_root: str, exp_root: str, name: str, **overrides):
+    """Miniature training config (mirrors the test suite's toy runs so the
+    in-process XLA compile cache is shared with them)."""
+    from ..config import Config, DatasetConfig, ParallelConfig
+
+    base: Dict[str, Any] = dict(
+        dataset=DatasetConfig(name="omniglot_toy", path=data_root),
+        num_classes_per_set=3,
+        num_samples_per_class=2,
+        num_target_samples=2,
+        batch_size=2,
+        parallel=ParallelConfig(dp=2),
+        total_epochs=2,
+        total_iter_per_epoch=3,
+        num_evaluation_tasks=4,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        experiment_root=exp_root,
+        experiment_name=name,
+        load_into_memory=True,
+        num_dataprovider_workers=2,
+        train_val_test_split=(0.6, 0.2, 0.2),
+        conv_via_patches=True,  # the dp-sharded native-conv GSPMD crash dodge
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def tiny_system(cfg):
+    """The shrunken 2-stage/4-filter backbone every campaign episode trains."""
+    from ..core import MAMLSystem
+    from ..models import build_vgg
+
+    return MAMLSystem(
+        cfg,
+        model=build_vgg(
+            (28, 28, 1),
+            cfg.num_classes_per_set,
+            num_stages=2,
+            cnn_num_filters=4,
+            conv_via_patches=True,
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# episode menu
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Episode:
+    """One sampled chaos episode: a mode, the fault specs to arm, config
+    knobs, and the rc set this fault family is *documented* to produce
+    (checked against the FAULTED leg; clean resume legs must always exit 0)."""
+
+    kind: str
+    mode: str  # train | resume | shrink | serve
+    faults: List[str] = field(default_factory=list)
+    resilience_overrides: Dict[str, Any] = field(default_factory=dict)
+    expected_rcs: tuple = (0,)
+    subprocess: bool = False  # faulted leg needs a fresh interpreter (os._exit)
+    resume_after: bool = False  # run a clean resume leg after the faulted one
+    resume_devices: int = 8  # visible devices for a subprocess resume leg
+    required_events: tuple = ()  # event names that must appear in events.jsonl
+
+
+def episode_menu(rng: np.random.RandomState) -> List[Episode]:
+    """The full seam-coverage menu, trigger indices jittered by ``rng`` so
+    consecutive campaigns with different seeds walk different step indices.
+    Six dispatches per train episode (2 epochs x 3 iters) bound the jitter."""
+    nth = lambda lo, hi: int(rng.randint(lo, hi + 1))  # noqa: E731
+    menu = [
+        Episode(
+            kind="nan-isolated",
+            mode="train",
+            faults=[f"runner.step=nan-loss:nth={nth(1, 4)}",
+                    "checkpoint.write=delay:delay_s=0.01,nth=1"],
+            resilience_overrides=dict(max_consecutive_bad_steps=3),
+            expected_rcs=(0,),
+            required_events=("nan_step_skipped",),
+        ),
+        Episode(
+            kind="nan-persistent",
+            mode="train",
+            faults=["runner.step=nan-loss:p=1.0"],
+            resilience_overrides=dict(max_consecutive_bad_steps=1, max_rollbacks=1),
+            expected_rcs=(3,),
+            required_events=("nan_rollback", "nan_abort"),
+        ),
+        Episode(
+            kind="sigterm-preempt",
+            mode="train",
+            faults=[f"runner.step=sigterm:nth={nth(2, 4)}"],
+            expected_rcs=(75,),
+            resume_after=True,
+            required_events=("preempted",),
+        ),
+        Episode(
+            kind="loader-transient-io",
+            mode="train",
+            faults=[f"loader.episode=raise:nth={nth(1, 3)}"],
+            resilience_overrides=dict(loader_io_backoff_s=0.0),
+            expected_rcs=(0,),
+        ),
+        Episode(
+            kind="corrupt-latest-read",
+            mode="resume",
+            faults=["checkpoint.read=corrupt-bytes:nth=1"],
+            expected_rcs=(0,),
+        ),
+        Episode(
+            kind="wedge-hung-step",
+            mode="train",
+            faults=[f"runner.step=delay:delay_s=60,nth={nth(2, 5)}"],
+            expected_rcs=(76,),
+            subprocess=True,
+            resume_after=True,
+            required_events=("wedged", "wedge_checkpoint"),
+        ),
+        Episode(
+            kind="device-shrink-resume",
+            mode="shrink",
+            expected_rcs=(0,),
+            subprocess=True,
+            resume_devices=1,
+            required_events=("degraded_mesh",),
+        ),
+        Episode(kind="serve-dispatch-raise", mode="serve"),
+        Episode(kind="serve-dispatch-hang", mode="serve"),
+    ]
+    order = rng.permutation(len(menu))
+    return [menu[i] for i in order]
+
+
+def sample_episodes(
+    seed: int, n: int, include_subprocess: bool = True
+) -> List[Episode]:
+    rng = np.random.RandomState(seed)
+    episodes: List[Episode] = []
+    while len(episodes) < n:
+        for ep in episode_menu(rng):
+            if len(episodes) >= n:
+                break
+            if ep.subprocess and not include_subprocess:
+                continue
+            episodes.append(ep)
+    return episodes
+
+
+# ---------------------------------------------------------------------------
+# invariants
+# ---------------------------------------------------------------------------
+
+
+def _events_in(run_dir: str) -> List[str]:
+    path = os.path.join(run_dir, "logs", "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    names = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                try:
+                    names.append(json.loads(line).get("event", ""))
+                except json.JSONDecodeError:
+                    pass
+    return names
+
+
+def _check_events_jsonl(run_dir: str) -> Optional[str]:
+    path = os.path.join(run_dir, "logs", "events.jsonl")
+    if not os.path.exists(path):
+        return None  # an episode may die before its first event — fine
+    with open(path) as f:
+        for i, line in enumerate(f):
+            if not line.strip():
+                continue
+            try:
+                json.loads(line)
+            except json.JSONDecodeError as exc:
+                return f"events.jsonl line {i + 1} unparseable: {exc}"
+    return None
+
+
+def _check_checkpoints(run_dir: str, template_state) -> Optional[str]:
+    from ..experiment import checkpoint as ckpt
+
+    save_dir = os.path.join(run_dir, "saved_models")
+    has_any = os.path.isdir(save_dir) and any(
+        name.startswith(ckpt.MODEL_NAME) and not name.endswith(".corrupt")
+        for name in os.listdir(save_dir)
+    )
+    if not has_any:
+        return None
+    try:
+        ckpt.load_latest_with_fallback(save_dir, template_state)
+    except Exception as exc:  # noqa: BLE001 — any load failure is the finding
+        return f"no loadable checkpoint despite files present: {exc!r}"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# episode execution
+# ---------------------------------------------------------------------------
+
+
+def _run_train_inprocess(cfg) -> int:
+    from ..experiment import ExperimentRunner
+
+    runner = ExperimentRunner(cfg, system=tiny_system(cfg))
+    try:
+        runner.run_experiment()
+        return 0
+    except SystemExit as exc:
+        return int(exc.code or 0)
+
+
+def _child_env(n_devices: int) -> Dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    # share the persistent XLA cache so children skip recompiles
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+    return env
+
+
+def _run_train_subprocess(cfg_yaml: str, n_devices: int, timeout_s: float = 420.0) -> int:
+    """Fork a fresh interpreter for episodes whose verdict is the process rc
+    of an ``os._exit`` path, or that need a different visible-device count."""
+    code = (
+        "import sys;"
+        "from howtotrainyourmamlpytorch_tpu.resilience.campaign import child_train_main;"
+        "sys.exit(child_train_main(sys.argv[1]))"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, cfg_yaml],
+        cwd=_REPO_ROOT,
+        env=_child_env(n_devices),
+        capture_output=True,
+        text=True,
+        timeout=timeout_s,
+    )
+    return proc.returncode
+
+
+def child_train_main(cfg_yaml: str) -> int:
+    """Subprocess entry: run one campaign training episode from its saved
+    config. Importable (not ``__main__``) so the parent can spawn it with a
+    one-line ``-c`` after pinning JAX env vars."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # site-hook override guard
+    # mirror conftest's persistent-cache tuning so tiny programs cache too
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+    from ..config import load_config
+
+    cfg = load_config(cfg_yaml)
+    return _run_train_inprocess(cfg)
+
+
+def _run_serve_episode(ep: Episode) -> List[str]:
+    """Serve-mode chaos: drive the frontend/HTTP stack under injected device
+    faults and enforce the serving-honesty invariant. Returns violations."""
+    import urllib.error
+    import urllib.request
+
+    from ..config import Config, ResilienceConfig, ServingConfig
+    from ..core import MAMLSystem
+    from ..data.synthetic import synthetic_batch
+    from ..models import build_vgg
+    from ..resilience.faults import FaultInjector
+    from ..resilience.retry import DeadlineExceededError
+    from ..serving import AdaptationEngine, ServingFrontend, make_http_server
+    from .faults import InjectedFault
+
+    violations: List[str] = []
+    img = (28, 28, 1)
+    cfg = Config(
+        num_classes_per_set=5,
+        num_samples_per_class=2,
+        num_target_samples=3,
+        batch_size=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        serving=ServingConfig(support_buckets=[16], query_buckets=[16]),
+    )
+    system = MAMLSystem(
+        cfg, model=build_vgg(img, cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4)
+    )
+
+    def support(seed):
+        epi = synthetic_batch(1, 5, 2, 3, img, seed=seed)
+        return epi["x_support"][0], epi["y_support"][0]
+
+    if ep.kind == "serve-dispatch-raise":
+        # HTTP end-to-end: injected dispatch failures trip the breaker; the
+        # wire must show 500 -> 500 -> fast 503 (+ Retry-After) and a
+        # degraded /healthz — and any 200 must carry a real payload. The
+        # serving.http delay also exercises the handler seam.
+        inj = FaultInjector.from_specs(
+            ["serving.dispatch=raise:times=2", "serving.http=delay:delay_s=0.01"],
+            include_env=False,
+        )
+        engine = AdaptationEngine(system, system.init_train_state(), injector=inj)
+        res = ResilienceConfig(breaker_failure_threshold=2, breaker_cooldown_s=60.0)
+        frontend = ServingFrontend(engine, resilience_cfg=res)
+        server = make_http_server(frontend, "127.0.0.1", 0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        statuses = []
+        try:
+            for seed in (1, 2, 3):
+                x_s, y_s = support(seed)
+                req = urllib.request.Request(
+                    base + "/adapt",
+                    data=json.dumps(
+                        {"x_support": x_s.tolist(), "y_support": y_s.tolist()}
+                    ).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as resp:
+                        statuses.append(resp.status)
+                        body = json.loads(resp.read())
+                        if resp.status == 200 and "adaptation_id" not in body:
+                            violations.append(
+                                f"200 without adaptation_id: {body!r}"
+                            )
+                except urllib.error.HTTPError as exc:
+                    statuses.append(exc.code)
+                    if exc.code not in (400, 404, 500, 503, 504):
+                        violations.append(f"undocumented HTTP status {exc.code}")
+                    if exc.code == 503 and "Retry-After" not in exc.headers:
+                        violations.append("503 without Retry-After")
+            if statuses != [500, 500, 503]:
+                violations.append(
+                    f"breaker wire sequence {statuses} != [500, 500, 503]"
+                )
+            try:
+                with urllib.request.urlopen(base + "/healthz", timeout=60):
+                    violations.append("healthz 200 while breaker open")
+            except urllib.error.HTTPError as exc:
+                if exc.code != 503:
+                    violations.append(f"healthz {exc.code} while breaker open")
+            with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
+                json.loads(resp.read())  # must be well-formed
+        finally:
+            server.shutdown()
+            server.server_close()
+            frontend.close()
+            thread.join(timeout=5)
+    elif ep.kind == "serve-dispatch-hang":
+        # A hanging dispatch must surface as DeadlineExceeded (504-class),
+        # never as a 200 or an unbounded wait. after=1 keeps the compile
+        # warmup dispatch clean so the injected delay measures the hang
+        # path, not XLA compile time.
+        inj = FaultInjector.from_specs(
+            ["serving.dispatch=delay:delay_s=0.4,after=1,times=2"],
+            include_env=False,
+        )
+        engine = AdaptationEngine(system, system.init_train_state(), injector=inj)
+        engine.adapt_batch([support(0)])  # warm: compile outside the drill
+        res = ResilienceConfig(
+            request_deadline_s=0.05, breaker_timeout_threshold=2,
+            breaker_cooldown_s=60.0,
+        )
+        frontend = ServingFrontend(engine, resilience_cfg=res)
+        try:
+            outcomes = []
+            for seed in (4, 5, 6):
+                try:
+                    out = frontend.adapt(*support(seed))
+                    if "adaptation_id" not in out:
+                        violations.append(f"success without adaptation_id: {out!r}")
+                    outcomes.append("ok")
+                except DeadlineExceededError:
+                    outcomes.append("deadline")
+                except Exception as exc:  # noqa: BLE001
+                    if exc.__class__.__name__ == "ServiceUnavailableError":
+                        outcomes.append("unavailable")
+                    elif isinstance(exc, InjectedFault):
+                        outcomes.append("fault")
+                    else:
+                        violations.append(f"undocumented outcome {exc!r}")
+            if "deadline" not in outcomes:
+                violations.append(
+                    f"hung dispatch never produced a deadline expiry: {outcomes}"
+                )
+            json.dumps(frontend.metrics())  # observability stays well-formed
+        finally:
+            frontend.close()
+    else:
+        violations.append(f"unknown serve episode kind {ep.kind!r}")
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# the campaign
+# ---------------------------------------------------------------------------
+
+
+def run_campaign(
+    work_dir: str,
+    episodes: int = 8,
+    seed: int = 0,
+    data_root: Optional[str] = None,
+    include_subprocess: bool = True,
+    log: Callable[[str], None] = lambda m: print(m, file=sys.stderr, flush=True),
+) -> Dict[str, Any]:
+    """Run a seeded chaos campaign and return the one-line JSON verdict
+    (also what ``scripts/chaos_soak.py`` prints). ``include_subprocess=False``
+    drops the fork-a-fresh-interpreter episodes (rc=76 wedge, device-shrink)
+    for fast in-process smokes; the CLI keeps them."""
+    from ..config import save_config
+    from ..experiment import ExperimentRunner
+
+    os.makedirs(work_dir, exist_ok=True)
+    data_root = data_root or make_toy_dataset(os.path.join(work_dir, "toy_data"))
+    exp_root = os.path.join(work_dir, "exps")
+    plan = sample_episodes(seed, episodes, include_subprocess)
+    template = None  # built lazily: one init_train_state serves every check
+    results: List[Dict[str, Any]] = []
+    violations: List[Dict[str, Any]] = []
+    t0 = time.time()
+
+    for i, ep in enumerate(plan):
+        name = f"ep{i:02d}_{ep.kind}_s{seed}"
+        log(f"chaos: episode {i + 1}/{len(plan)} {ep.kind} ({ep.mode})")
+        ep_viol: List[str] = []
+        rcs: List[int] = []
+        run_dir = os.path.join(exp_root, name)
+
+        if ep.mode == "serve":
+            ep_viol += _run_serve_episode(ep)
+        else:
+            if (
+                any("sigterm" in f for f in ep.faults)
+                and threading.current_thread() is not threading.main_thread()
+            ):
+                # SIGTERM drills need the runner's main-thread handler; off
+                # the main thread the default handler would kill the whole
+                # campaign process
+                log(f"chaos: skipping {ep.kind} off the main thread")
+                results.append({"kind": ep.kind, "skipped": True})
+                continue
+            base = campaign_config(data_root, exp_root, name)
+            faulted = dataclasses.replace(
+                base,
+                resilience=dataclasses.replace(
+                    base.resilience,
+                    faults=list(ep.faults),
+                    **ep.resilience_overrides,
+                ),
+            )
+            if ep.subprocess and ep.mode == "train":
+                # tightened watchdog so the wedge drill resolves quickly —
+                # but the deadline must still clear one COLD-cache XLA
+                # compile (~10-20s on a 1-core box), or the drill fires
+                # during healthy compile and goes green without ever testing
+                # the injected hang. The clean legs keep the production
+                # default entirely.
+                faulted = dataclasses.replace(
+                    faulted,
+                    resilience=dataclasses.replace(
+                        faulted.resilience,
+                        watchdog=dataclasses.replace(
+                            faulted.resilience.watchdog,
+                            deadline_s=25.0,
+                            poll_s=0.5,
+                        ),
+                    ),
+                )
+
+            def _run(cfg, in_subprocess: bool, n_devices: int = 8) -> int:
+                if not in_subprocess:
+                    return _run_train_inprocess(cfg)
+                os.makedirs(run_dir, exist_ok=True)
+                cfg_yaml = os.path.join(
+                    run_dir, f"chaos_leg{len(rcs)}.yaml"
+                )
+                save_config(cfg, cfg_yaml)
+                return _run_train_subprocess(cfg_yaml, n_devices=n_devices)
+
+            fault_rc: Optional[int] = None
+            if ep.mode == "train":
+                fault_rc = _run(faulted, ep.subprocess)
+                rcs.append(fault_rc)
+                if ep.resume_after or fault_rc in (75, 76):
+                    # clean resume leg: the faulted run must have left a
+                    # resumable run dir behind
+                    rcs.append(_run(base, False))
+            elif ep.mode == "resume":
+                rcs.append(_run(base, False))  # produce the checkpoints
+                fault_rc = _run(faulted, False)  # resume under the fault
+                rcs.append(fault_rc)
+            elif ep.mode == "shrink":
+                # train on the full mesh, then resume with fewer visible
+                # devices than ParallelConfig demands — the degraded-mesh
+                # path must shrink and keep training, not crash
+                rcs.append(_run(base, False))
+                fault_rc = _run(
+                    dataclasses.replace(base, total_epochs=3),
+                    True,
+                    n_devices=ep.resume_devices,
+                )
+                rcs.append(fault_rc)
+            for rc in rcs:
+                if rc not in DOCUMENTED_RCS:
+                    ep_viol.append(f"undocumented rc {rc}")
+            if fault_rc is not None and fault_rc not in ep.expected_rcs:
+                ep_viol.append(
+                    f"rc {fault_rc} not in expected {ep.expected_rcs} for {ep.kind}"
+                )
+            if (ep.resume_after or ep.mode in ("resume", "shrink")) and rcs[-1] != 0:
+                ep_viol.append(f"resume leg exited rc {rcs[-1]}")
+            err = _check_events_jsonl(run_dir)
+            if err:
+                ep_viol.append(err)
+            seen_events = _events_in(run_dir)
+            for required in ep.required_events:
+                if required not in seen_events:
+                    ep_viol.append(f"missing required event {required!r}")
+            if template is None:
+                template = tiny_system(
+                    campaign_config(data_root, exp_root, "_tmpl")
+                ).init_train_state()
+            err = _check_checkpoints(run_dir, template)
+            if err:
+                ep_viol.append(err)
+
+        results.append(
+            {"kind": ep.kind, "mode": ep.mode, "rcs": rcs, "violations": ep_viol}
+        )
+        for v in ep_viol:
+            violations.append({"episode": i, "kind": ep.kind, "violation": v})
+
+    verdict = {
+        "campaign": "chaos_soak",
+        "seed": seed,
+        "episodes": len(results),
+        "ok": not violations,
+        "violations": violations,
+        "invariants": [
+            "rc in {0,3,75,76}",
+            "latest-or-fallback checkpoint loads",
+            "events.jsonl well-formed",
+            "serving never 200s a shed/failed payload",
+        ],
+        "episode_results": results,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    return verdict

@@ -1,0 +1,207 @@
+"""Heartbeat watchdog: turn an uninterruptible hang into a bounded restart.
+
+The failure class this covers is the one the rest of the resilience subsystem
+cannot: a device call that *hangs instead of raising*. A wedged tunnel parks
+the main thread in C with the GIL released — no exception ever surfaces, no
+signal handler runs on the hung thread, and the NaN sentinel / breaker /
+checkpoint integrity machinery all sit behind a call that never returns.
+BENCH_r03–r05 each lost their round to exactly this (rc=124 from the outer
+``timeout``, 15 probes x 90s of wedged tunnel); on a sweep it costs
+``STALL_SECS`` of wall clock per incident plus whatever mid-epoch progress the
+log-staleness kill throws away.
+
+:class:`HeartbeatWatchdog` is the in-process version of the sweep's
+log-staleness kill, with two advantages: it knows the *semantic* progress
+unit (a dispatched/settled step, a completed flush — not just "some stdout"),
+and it can salvage state on the way out (thread stacks for the post-mortem,
+an emergency checkpoint from the last settled host state) because it runs on
+a live secondary thread while the main thread is hung. The exit is
+``os._exit`` with the dedicated **rc=76** ("wedged") code — like the
+preemption code 75, ``scripts/sweep.sh`` treats it as restart-not-fail; unlike
+75 it says "the process was killed from inside, the device path is suspect".
+
+Progress can be reported two ways (combinable):
+
+- **push**: callers sprinkle :meth:`beat` at the real progress points (the
+  runner beats per dispatch/settle/eval batch/checkpoint write);
+- **poll**: ``progress_fn`` returns a monotonically non-decreasing counter
+  (e.g. a batcher's completed-flush count) sampled every ``poll_s``; any
+  advance counts as a beat. ``pending_fn`` gates the deadline entirely: while
+  it returns falsy (no work in flight) the clock is held reset, so an *idle*
+  component is never "wedged".
+
+The watchdog is armed only inside :meth:`watching` (or explicit
+:meth:`arm` / :meth:`disarm`) so construction is free and nothing fires
+outside the supervised region. ``clock``/``exit_fn`` are injectable for
+tests; the drill path uses the existing ``delay`` fault kind at the
+``runner.step`` / ``serving.dispatch`` seams — a delay longer than the
+deadline is behaviorally a wedge (the loop thread stops beating) without
+needing real broken hardware.
+"""
+
+import contextlib
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+#: The wedge exit code's contract (mirrors 75/EX_TEMPFAIL for preemption):
+#: restartable, but the harness should gate on the backend before relaunch.
+WEDGE_EXIT_CODE = 76
+
+
+def dump_all_thread_stacks() -> Dict[str, List[str]]:
+    """Stack of every live thread, keyed ``"<name> (<ident>)"`` — the
+    post-mortem payload for ``events.jsonl``. Safe to call from any thread;
+    the hung thread's frame shows exactly which device call never returned."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks: Dict[str, List[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, 'unknown')} ({ident})"
+        stacks[label] = [
+            line.rstrip("\n") for line in traceback.format_stack(frame)
+        ]
+    return stacks
+
+
+class HeartbeatWatchdog:
+    """Supervise a work loop; a zero-progress interval past ``deadline_s``
+    calls ``on_wedge(info)`` once and then ``exit_fn(exit_code)``.
+
+    ``on_wedge`` receives ``{"stage", "stall_s", "beats", "threads"}`` and
+    runs on the watchdog thread — it must only do host-side work (event log,
+    emergency checkpoint from an already-host-resident state); touching the
+    device would just hang a second thread. Exceptions in ``on_wedge`` are
+    swallowed: a broken post-mortem must not turn rc=76 into a zombie."""
+
+    def __init__(
+        self,
+        deadline_s: float,
+        on_wedge: Optional[Callable[[Dict[str, Any]], None]] = None,
+        poll_s: float = 0.0,
+        exit_code: int = WEDGE_EXIT_CODE,
+        exit_fn: Callable[[int], None] = os._exit,
+        clock: Callable[[], float] = time.monotonic,
+        progress_fn: Optional[Callable[[], int]] = None,
+        pending_fn: Optional[Callable[[], bool]] = None,
+        name: str = "watchdog",
+    ):
+        if deadline_s <= 0:
+            raise ValueError(f"watchdog deadline_s must be > 0, got {deadline_s}")
+        self.deadline_s = float(deadline_s)
+        # poll often enough to catch a short test deadline, rarely enough to
+        # be free at the production default (900s deadline -> 5s polls)
+        self.poll_s = float(poll_s) if poll_s > 0 else min(
+            max(self.deadline_s / 10.0, 0.02), 5.0
+        )
+        self.exit_code = int(exit_code)
+        self._on_wedge = on_wedge
+        self._exit_fn = exit_fn
+        self._clock = clock
+        self._progress_fn = progress_fn
+        self._pending_fn = pending_fn
+        self.name = name
+        self._lock = threading.Lock()
+        self._armed = False
+        self._stopped = False
+        self._fired = False
+        self._beats = 0
+        self._stage = "init"
+        self._last_beat = self._clock()
+        self._last_progress: Optional[int] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- progress ------------------------------------------------------
+
+    def beat(self, stage: Optional[str] = None) -> None:
+        """One unit of real progress (push mode). Cheap: a lock + two
+        assignments — fine on a per-dispatch hot path."""
+        with self._lock:
+            self._beats += 1
+            self._last_beat = self._clock()
+            if stage is not None:
+                self._stage = stage
+
+    # -- arming --------------------------------------------------------
+
+    def arm(self, stage: Optional[str] = None) -> None:
+        with self._lock:
+            self._armed = True
+            self._stopped = False  # re-armable after stop() (back-to-back runs)
+            self._last_beat = self._clock()
+            if stage is not None:
+                self._stage = stage
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._watch, name=f"{self.name}-heartbeat", daemon=True
+                )
+                self._thread.start()
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed = False
+
+    def stop(self) -> None:
+        with self._lock:
+            self._armed = False
+            self._stopped = True
+
+    @contextlib.contextmanager
+    def watching(self, stage: Optional[str] = None):
+        self.arm(stage)
+        try:
+            yield self
+        finally:
+            self.disarm()
+
+    # -- the supervisor loop -------------------------------------------
+
+    def check(self) -> bool:
+        """One supervision step; True when the wedge action fired. Exposed
+        so unit tests can drive the state machine with a fake clock instead
+        of sleeping through real deadlines."""
+        with self._lock:
+            if not self._armed or self._fired:
+                return False
+            now = self._clock()
+            if self._pending_fn is not None and not self._pending_fn():
+                # idle is not wedged: hold the clock reset while nothing is
+                # in flight
+                self._last_beat = now
+                return False
+            if self._progress_fn is not None:
+                progress = self._progress_fn()
+                if progress != self._last_progress:
+                    self._last_progress = progress
+                    self._last_beat = now
+                    return False
+            stall = now - self._last_beat
+            if stall <= self.deadline_s:
+                return False
+            self._fired = True
+            info = {
+                "stage": self._stage,
+                "stall_s": round(stall, 3),
+                "beats": self._beats,
+                "deadline_s": self.deadline_s,
+            }
+        # outside the lock: on_wedge may log/checkpoint at length, and a
+        # beat arriving now changes nothing — the verdict is already in
+        info["threads"] = dump_all_thread_stacks()
+        if self._on_wedge is not None:
+            try:
+                self._on_wedge(info)
+            except BaseException:  # noqa: BLE001 — the exit must still happen
+                traceback.print_exc()
+        self._exit_fn(self.exit_code)
+        return True
+
+    def _watch(self) -> None:
+        while True:
+            time.sleep(self.poll_s)
+            with self._lock:
+                if self._stopped:
+                    return
+            self.check()

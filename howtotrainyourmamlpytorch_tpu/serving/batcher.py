@@ -62,6 +62,11 @@ class MicroBatcher:
         # worker-progress signal server._dispatch uses to tell a backlogged
         # worker from a wedged one when a queued request's deadline expires
         self.flushes_done = 0
+        # requests currently INSIDE flush_fn (no longer queued, not yet
+        # resolved): queue_depth + in_flight is the wedge watchdog's
+        # "work pending" signal — a worker parked forever in a hung device
+        # dispatch has queue_depth 0 but in_flight > 0
+        self.in_flight = 0
         self.batched_requests = 0  # requests that shared a flush with others
         self._worker = threading.Thread(
             target=self._run, name=f"{name}-flush", daemon=True
@@ -101,6 +106,12 @@ class MicroBatcher:
         with self._lock:
             return self.flushes_done
 
+    def pending(self) -> int:
+        """Requests queued or mid-flush — nonzero means the worker has work
+        it is accountable for making progress on."""
+        with self._lock:
+            return sum(len(g) for g in self._groups.values()) + self.in_flight
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             flushes = self.flushes_full + self.flushes_deadline
@@ -114,6 +125,7 @@ class MicroBatcher:
                 "batched_requests": self.batched_requests,
                 "mean_batch": (self.requests / flushes) if flushes else 0.0,
                 "queue_depth": sum(len(g) for g in self._groups.values()),
+                "in_flight": self.in_flight,
             }
 
     def close(self) -> None:
@@ -184,8 +196,16 @@ class MicroBatcher:
             # worker thread
             group = [(p, fut, t) for p, fut, t in group if not fut.cancelled()]
             if not group:
+                # dropping an all-cancelled group is still worker liveness:
+                # without counting it, a deadline tight enough to cancel
+                # every queued request reads as zero progress and the wedge
+                # watchdog rc=76s a demonstrably live worker
+                with self._lock:
+                    self.flushes_done += 1
                 continue
             payloads = [p for p, _, _ in group]
+            with self._lock:
+                self.in_flight = len(group)
             try:
                 results = self._flush_fn(key, payloads)
                 if len(results) != len(group):
@@ -196,11 +216,13 @@ class MicroBatcher:
             except BaseException as exc:  # noqa: BLE001 — fail the futures, keep serving
                 with self._lock:
                     self.flushes_done += 1  # an exception is still progress
+                    self.in_flight = 0
                 for _, fut, _ in group:
                     self._complete(fut, exc=exc)
                 continue
             with self._lock:
                 self.flushes_done += 1
+                self.in_flight = 0
             for (_, fut, _), res in zip(group, results):
                 self._complete(fut, result=res)
 

@@ -22,6 +22,7 @@ whose single worker serializes device dispatch.
 import concurrent.futures
 import json
 import os
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -33,6 +34,7 @@ import numpy as np
 from ..config import Config, ResilienceConfig, ServingConfig
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.retry import DeadlineExceededError
+from ..resilience.watchdog import HeartbeatWatchdog
 from .batcher import MicroBatcher, QueueFullError
 from .cache import AdaptedWeightCache, support_digest
 from .engine import AdaptationEngine
@@ -60,6 +62,7 @@ class ServingFrontend:
         serving_cfg: Optional[ServingConfig] = None,
         resilience_cfg: Optional[ResilienceConfig] = None,
         clock=time.monotonic,
+        wedge_exit=None,
     ):
         self.engine = engine
         self.serving = serving_cfg or engine.serving
@@ -94,6 +97,57 @@ class ServingFrontend:
         )
         self._started = time.monotonic()
         self._closed = False
+        # wedge watchdogs over the batcher flush workers (poll mode): work
+        # pending (queued or mid-flush) with zero completed flushes across
+        # the whole deadline means that worker is parked in a hung device
+        # dispatch. The breaker already fail-fasts *clients* on that
+        # signature; it cannot un-hang the worker thread — only a process
+        # restart can, so the watchdog dumps stacks and exits rc=76 for the
+        # supervisor. ONE WATCHDOG PER BATCHER: progress is per-worker, so a
+        # hung adapt worker is never masked by a predict worker that keeps
+        # completing flushes. Disabled (watchdog.serve_enabled=false) it
+        # costs nothing; ``wedge_exit`` is injectable for drills.
+        self._watchdogs: list = []
+        wd_cfg = getattr(self.resilience, "watchdog", None)
+        if wd_cfg is not None and wd_cfg.enabled and wd_cfg.serve_enabled:
+            for batcher in (self._adapt_batcher, self._predict_batcher):
+                wd = HeartbeatWatchdog(
+                    deadline_s=wd_cfg.serve_deadline_s,
+                    poll_s=wd_cfg.poll_s,
+                    on_wedge=self._on_wedge,
+                    exit_code=wd_cfg.wedge_exit_code,
+                    exit_fn=wedge_exit if wedge_exit is not None else os._exit,
+                    progress_fn=batcher.flushes_completed,
+                    pending_fn=batcher.pending,
+                    name=f"serving-{batcher.name}",
+                )
+                wd.arm(batcher.name)
+                self._watchdogs.append(wd)
+
+    def _on_wedge(self, info: Dict[str, Any]) -> None:
+        """Serving wedge post-mortem: one structured JSON line + per-thread
+        stacks on stderr (a server has no run dir to own an events.jsonl),
+        then the watchdog exits with the wedge code."""
+        self.counters.inc("wedged")
+        print(
+            json.dumps(
+                {
+                    "event": "wedged",
+                    "component": "serving",
+                    "stage": info["stage"],
+                    "stall_s": info["stall_s"],
+                    "adapt_batcher": self._adapt_batcher.stats(),
+                    "predict_batcher": self._predict_batcher.stats(),
+                }
+            ),
+            file=sys.stderr,
+            flush=True,
+        )
+        for label, stack in info["threads"].items():
+            print(f"--- thread {label} ---", file=sys.stderr)
+            for line in stack:
+                print(line, file=sys.stderr)
+        sys.stderr.flush()
 
     # ------------------------------------------------------------------
 
@@ -243,6 +297,8 @@ class ServingFrontend:
         if self._closed:
             return
         self._closed = True
+        for wd in self._watchdogs:
+            wd.stop()
         self._adapt_batcher.close()
         self._predict_batcher.close()
 

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Seeded chaos-soak campaign over the resilience subsystem.
+
+Usage:
+    python scripts/chaos_soak.py --episodes 8 --seed 0 [--work-dir DIR]
+        [--no-subprocess]
+
+Samples fault injections across every registered seam (checkpoint
+read/write, loader episode assembly, runner step dispatch, serving dispatch,
+HTTP handler — see ``resilience/faults.py``), runs a short train / resume /
+shrink / serve episode under each, and checks the cross-cutting invariants
+after every one (documented rc, loadable latest-or-fallback checkpoint,
+well-formed events.jsonl, serving never 200s a failure). Deterministic in
+``--seed``.
+
+Prints exactly ONE JSON verdict line on stdout (the ``bench.py`` contract);
+progress goes to stderr. Exit 0 iff every invariant held.
+
+Runs on host CPU with 8 virtual devices by default (the same virtual-mesh
+setup the test suite uses), so it is safe to run anywhere — it never touches
+a real TPU unless CHAOS_ON_DEVICE=1.
+"""
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import tempfile
+
+# env must be pinned BEFORE jax (imported transitively by the campaign):
+# chaos episodes are a host-side drill, not chip work
+if os.environ.get("CHAOS_ON_DEVICE") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax  # noqa: E402
+
+if os.environ.get("CHAOS_ON_DEVICE") != "1":
+    # a site hook may have imported jax earlier with another platform
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+from howtotrainyourmamlpytorch_tpu.resilience.campaign import run_campaign  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--episodes", type=int, default=8)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--work-dir",
+        default="",
+        help="campaign scratch dir (default: a fresh temp dir)",
+    )
+    parser.add_argument(
+        "--no-subprocess",
+        action="store_true",
+        help="skip fork-a-fresh-interpreter episodes (rc=76 wedge, "
+        "device-shrink) — faster, less coverage",
+    )
+    args = parser.parse_args(argv)
+    work_dir = args.work_dir or tempfile.mkdtemp(prefix="chaos_soak_")
+    # in-process episodes print training progress; the one-JSON-line stdout
+    # contract sends all of that to stderr
+    with contextlib.redirect_stdout(sys.stderr):
+        verdict = run_campaign(
+            work_dir,
+            episodes=args.episodes,
+            seed=args.seed,
+            include_subprocess=not args.no_subprocess,
+        )
+    print(json.dumps(verdict), flush=True)
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

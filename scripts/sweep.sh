@@ -33,7 +33,7 @@ run () {
     # don't burn an attempt against a wedged tunnel: wait (<=1h) until a
     # bounded probe actually sees the chip
     python -u scripts/wait_for_tpu.py >> exps/sweep_r3.log 2>&1 || \
-      echo "=== $(date -u +%H:%M:%S) $name: TPU wait gate exited nonzero (deadline or launch failure), trying anyway" >> exps/sweep_r3.log
+      echo "=== $(date -u +%H:%M:%S) $name: TPU wait gate exited nonzero (64=deadline, 65=wedged tunnel, else launch failure), trying anyway" >> exps/sweep_r3.log
     echo "=== $(date -u +%H:%M:%S) start $name attempt=$attempt" >> exps/sweep_r3.log
     # appending with >> does not update mtime on spawn: reset the liveness
     # clock so a restart gets the full STALL_SECS window
@@ -60,19 +60,26 @@ run () {
       echo "=== $(date -u +%H:%M:%S) $name EARLY-ABORTED (diverged), not retrying" >> exps/sweep_r3.log
       return 1
     fi
-    if [ $rc -eq 75 ]; then
-      # runner's preemption exit (resilience.preemption_exit_code, SIGTERM/
-      # SIGINT emergency checkpoint): restart-not-fail — the checkpoint
-      # carries the mid-epoch cursor, resume is exact and makes progress,
-      # so don't burn a watchdog attempt on it
-      # bounded: each restart resumes mid-epoch (forward progress), but a
-      # SIGTERM-happy environment must not loop forever
+    if [ $rc -eq 75 ] || [ $rc -eq 76 ]; then
+      # restart-not-fail codes, both backed by an emergency checkpoint:
+      #   75 = runner's preemption exit (SIGTERM/SIGINT, mid-epoch cursor —
+      #        resume is exact and makes progress)
+      #   76 = runner's wedge watchdog (zero progress past the deadline;
+      #        thread stacks in logs/events.jsonl, checkpoint from the last
+      #        settled state — the loop-head TPU gate waits out the wedged
+      #        tunnel before the relaunch touches the chip)
+      # bounded: a SIGTERM-happy environment or a tunnel that wedges every
+      # epoch must not loop forever
       preempts=$((preempts + 1))
       if [ "$preempts" -gt $((MAX_RESTARTS * 3)) ]; then
-        echo "=== $(date -u +%H:%M:%S) $name preempted $preempts times, giving up" >> exps/sweep_r3.log
+        echo "=== $(date -u +%H:%M:%S) $name preempted/wedged $preempts times, giving up" >> exps/sweep_r3.log
         return 1
       fi
-      echo "=== $(date -u +%H:%M:%S) $name PREEMPTED (emergency checkpoint), restarting free ($preempts)" >> exps/sweep_r3.log
+      if [ $rc -eq 76 ]; then
+        echo "=== $(date -u +%H:%M:%S) $name WEDGED (watchdog rc=76, emergency checkpoint), restarting free ($preempts)" >> exps/sweep_r3.log
+      else
+        echo "=== $(date -u +%H:%M:%S) $name PREEMPTED (emergency checkpoint), restarting free ($preempts)" >> exps/sweep_r3.log
+      fi
       sleep 2
       continue
     fi

@@ -6,15 +6,32 @@ so the sweep harness calls this BEFORE each training attempt instead of
 burning watchdog restarts against a dead backend.
 
 Each probe is a separate python child (backend init happens once per
-process) killed on timeout. Exits 0 when a probe sees the TPU, 1 when the
-deadline passes.
+process) killed on timeout. Two distinct give-up modes, with distinct exit
+codes so harnesses can react differently:
+
+- ``--deadline-s`` elapsed (**rc=64**): the backend never came up in the
+  time budget — mixed failures, maybe it is being rotated; trying anyway is
+  a coin flip.
+- ``--max-wedged-probes`` consecutive probe *timeouts* (**rc=65**): every
+  single probe hung, the wedged-tunnel signature. BENCH_r05 burned ~30 min
+  re-probing a dead tunnel 15 times; K consecutive hangs says the tunnel is
+  down for the count — stop immediately and let the caller emit its partial
+  artifact instead of waiting out the full deadline.
 
 Also importable: ``wait_for_backend(...)`` is the single definition of
 "backend up" shared by this gate and bench.py, so the two can't drift on
 semantics like whether jax's silent CPU fallback counts (it does NOT,
 unless allow_cpu: a fast-erroring tunnel would otherwise pass the gate and
-launch a useless single-core run).
+launch a useless single-core run). It returns a status string: ``"up"``
+(truthy) or the falsy-when-compared give-up reasons ``"deadline"`` /
+``"wedged"`` — callers must compare against ``"up"``, not truthiness.
+
+The probe command itself is overridable via the ``WAIT_FOR_TPU_PROBE`` env
+var — the drill seam that lets tests (and chaos soaks) simulate a hung or
+erroring tunnel without real hardware.
 """
+import argparse
+import os
 import subprocess
 import sys
 import time
@@ -29,6 +46,11 @@ _PROBE_TPU = (
 )
 _PROBE_ANY = "import jax; d = jax.devices(); print('BACKEND_OK', len(d), d[0].device_kind)"
 
+#: exit codes (documented in docs/OPERATIONS.md rc table)
+RC_UP = 0
+RC_DEADLINE = 64
+RC_WEDGED = 65
+
 
 def wait_for_backend(
     deadline_s: float = 3600.0,
@@ -36,12 +58,20 @@ def wait_for_backend(
     allow_cpu: bool = False,
     label: str = "wait_for_tpu",
     log=print,
-) -> bool:
+    max_consecutive_wedged: int = 5,
+    probe_interval_s: float = 30.0,
+    sleep=time.sleep,
+) -> str:
     """Probe until a child process sees a non-CPU backend (or any backend,
-    with allow_cpu) or deadline_s passes. Returns True when up."""
-    probe = _PROBE_ANY if allow_cpu else _PROBE_TPU
+    with allow_cpu), the deadline passes, or ``max_consecutive_wedged``
+    probes in a row hang (the dead-tunnel signature). Returns ``"up"`` /
+    ``"deadline"`` / ``"wedged"``."""
+    probe = os.environ.get("WAIT_FOR_TPU_PROBE") or (
+        _PROBE_ANY if allow_cpu else _PROBE_TPU
+    )
     start = time.time()
     attempt = 0
+    wedged_streak = 0
     while time.time() - start < deadline_s:
         attempt += 1
         diag = ""
@@ -57,23 +87,59 @@ def wait_for_backend(
                     f"{label}: backend up after {time.time()-start:.0f}s "
                     f"({attempt} probes): {out.stdout.strip().splitlines()[-1]}"
                 )
-                return True
+                return "up"
+            wedged_streak = 0  # it answered (badly) — not the hang signature
             diag = f"rc={out.returncode} stderr: ...{out.stderr.strip()[-200:]}"
         except subprocess.TimeoutExpired:
-            diag = f"hung >{probe_timeout_s:.0f}s (wedged tunnel)"
+            wedged_streak += 1
+            diag = (
+                f"hung >{probe_timeout_s:.0f}s (wedged tunnel, "
+                f"{wedged_streak}/{max_consecutive_wedged} consecutive)"
+            )
         elapsed = time.time() - start
         log(f"{label}: probe {attempt} failed ({elapsed:.0f}s elapsed): {diag}")
-        time.sleep(min(30.0, max(0.0, deadline_s - elapsed)))
+        if max_consecutive_wedged and wedged_streak >= max_consecutive_wedged:
+            log(
+                f"{label}: {wedged_streak} consecutive probes hung — tunnel "
+                "is wedged, giving up early"
+            )
+            return "wedged"
+        sleep(min(probe_interval_s, max(0.0, deadline_s - elapsed)))
     log(f"{label}: deadline exceeded")
-    return False
+    return "deadline"
 
 
-def main(deadline_s: float = 3600.0, probe_timeout_s: float = 90.0) -> int:
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    # positionals kept for the historical `wait_for_tpu.py 600 30` callers
+    parser.add_argument("deadline_pos", nargs="?", type=float, default=None)
+    parser.add_argument("probe_timeout_pos", nargs="?", type=float, default=None)
+    parser.add_argument("--deadline-s", type=float, default=None,
+                        help="hard wall-clock budget (default 3600)")
+    parser.add_argument("--probe-timeout-s", type=float, default=None,
+                        help="per-probe child timeout (default 90)")
+    parser.add_argument("--max-wedged-probes", type=int, default=5,
+                        help="consecutive hung probes before rc=65 (0 disables)")
+    parser.add_argument("--probe-interval-s", type=float, default=30.0,
+                        help="pause between probes")
+    args = parser.parse_args(argv)
+    deadline = args.deadline_s if args.deadline_s is not None else (
+        args.deadline_pos if args.deadline_pos is not None else 3600.0
+    )
+    probe_timeout = args.probe_timeout_s if args.probe_timeout_s is not None else (
+        args.probe_timeout_pos if args.probe_timeout_pos is not None else 90.0
+    )
+
     def log(msg):
         print(msg, flush=True)
 
-    return 0 if wait_for_backend(deadline_s, probe_timeout_s, log=log) else 1
+    status = wait_for_backend(
+        deadline, probe_timeout, log=log,
+        max_consecutive_wedged=args.max_wedged_probes,
+        probe_interval_s=args.probe_interval_s,
+    )
+    return {"up": RC_UP, "deadline": RC_DEADLINE, "wedged": RC_WEDGED}[status]
 
 
 if __name__ == "__main__":
-    sys.exit(main(*(float(a) for a in sys.argv[1:])))
+    sys.exit(main())

@@ -27,6 +27,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long drills (full chaos soak); tier-1 runs -m 'not slow'",
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)

@@ -129,6 +129,70 @@ time.sleep(60)
     assert "sigterm" in d["wedged_at"]
 
 
+def test_wait_for_backend_stops_after_consecutive_wedged_probes(monkeypatch):
+    """ISSUE 3 satellite: K consecutive hung probes (the dead-tunnel
+    signature — r05 burned ~30 min re-probing one 15 times) end the probe
+    loop immediately with the distinct 'wedged' status; a probe that
+    *answers* (even badly) resets the streak."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import wait_for_tpu
+
+    # a probe that hangs forever, killed by the per-probe timeout
+    monkeypatch.setenv("WAIT_FOR_TPU_PROBE", "import time; time.sleep(60)")
+    logs = []
+    t0 = time.monotonic()
+    status = wait_for_tpu.wait_for_backend(
+        deadline_s=600.0, probe_timeout_s=0.3, log=logs.append,
+        max_consecutive_wedged=3, sleep=lambda s: None,
+    )
+    assert status == "wedged"
+    assert time.monotonic() - t0 < 60  # 3 bounded probes, not the deadline
+    assert any("3/3 consecutive" in m for m in logs)
+
+    # an answering-but-failing probe is NOT the hang signature: the loop
+    # keeps probing until the deadline and reports 'deadline' instead
+    monkeypatch.setenv("WAIT_FOR_TPU_PROBE", "import sys; sys.exit(9)")
+    status = wait_for_tpu.wait_for_backend(
+        deadline_s=1.0, probe_timeout_s=5.0, log=lambda m: None,
+        max_consecutive_wedged=3, sleep=lambda s: None,
+    )
+    assert status == "deadline"
+
+    # rc mapping: the CLI gives each give-up mode a distinct nonzero code
+    assert wait_for_tpu.RC_UP == 0
+    assert wait_for_tpu.RC_DEADLINE == 64 and wait_for_tpu.RC_WEDGED == 65
+
+
+def test_bench_emits_partial_json_immediately_on_wedged_tunnel():
+    """bench.py gives up on a wedged tunnel after K hung probes and emits
+    its one structured JSON line at once — no in-process backend contact,
+    no 15x90s re-probe marathon."""
+    t0 = time.monotonic()
+    code = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+        env={
+            **os.environ,
+            # probes hang; tiny per-probe timeout; 2-strike wedge cutoff
+            "WAIT_FOR_TPU_PROBE": "import time; time.sleep(60)",
+            "BENCH_STARTUP_TIMEOUT_S": "0.3",
+            "BENCH_PROBE_INTERVAL_S": "0.05",
+            "BENCH_MAX_WEDGED_PROBES": "2",
+            "BENCH_STARTUP_DEADLINE_S": "600",
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    assert code.returncode == 2
+    assert time.monotonic() - t0 < 60
+    d = _only_json_line(code.stdout)
+    assert d["value"] is None
+    assert "wedged" in d["error"]
+    assert "2 consecutive" in d["error"]
+
+
 def test_disabled_watchdog_never_fires():
     code = subprocess.run(
         [

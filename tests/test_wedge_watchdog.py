@@ -1,0 +1,421 @@
+"""Wedge watchdog + elastic degraded-mesh resume (ISSUE 3 acceptance).
+
+The failure class PR 2 could not touch: a device call that hangs instead of
+raising (the BENCH_r03-r05 wedged-tunnel signature), and a device count that
+shrinks between runs. The drills here mirror the PR 2 SIGTERM drill shape:
+inject the failure, assert the bounded response (rc=76 + thread stacks in
+events.jsonl / a shrunken mesh + degraded_mesh event), then prove the
+subsequent resume matches an uninterrupted control run.
+
+The rc=76 path ends in ``os._exit`` and the device-shrink path needs a
+different visible-device count, so those legs run in subprocesses (via the
+chaos campaign's child entry); everything else is in-process with fake
+clocks and injected exit functions.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from howtotrainyourmamlpytorch_tpu.config import (
+    Config,
+    ParallelConfig,
+    ResilienceConfig,
+    WatchdogConfig,
+    save_config,
+)
+from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
+from howtotrainyourmamlpytorch_tpu.experiment.storage import EventLog
+from howtotrainyourmamlpytorch_tpu.parallel import degraded_mesh_plan
+from howtotrainyourmamlpytorch_tpu.resilience import HeartbeatWatchdog
+from howtotrainyourmamlpytorch_tpu.resilience.campaign import (
+    _child_env,
+    campaign_config,
+    tiny_system,
+)
+
+from tests.test_runner import runner_config, small_system, toy_dataset  # noqa: F401
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# HeartbeatWatchdog state machine (fake clock, injected exit)
+# ---------------------------------------------------------------------------
+
+
+def _wd(deadline=5.0, **kw):
+    t = {"now": 0.0}
+    exits, infos = [], []
+    wd = HeartbeatWatchdog(
+        deadline,
+        on_wedge=infos.append,
+        clock=lambda: t["now"],
+        exit_fn=exits.append,
+        poll_s=3600,  # the real thread never polls during the test
+        **kw,
+    )
+    return wd, t, exits, infos
+
+
+def test_watchdog_fires_once_past_deadline_with_stacks():
+    wd, t, exits, infos = _wd(5.0)
+    t["now"] = 100.0
+    assert not wd.check()  # not armed: a stale clock can't fire it
+    wd.arm("stage-a")
+    t["now"] = 104.0
+    assert not wd.check()  # within deadline
+    wd.beat("stage-b")  # progress resets the clock
+    t["now"] = 108.0
+    assert not wd.check()
+    t["now"] = 110.1  # 6.1s since the beat
+    assert wd.check()
+    assert exits == [76]
+    (info,) = infos
+    assert info["stage"] == "stage-b"
+    assert info["stall_s"] > 5.0
+    # every live thread's stack is in the post-mortem, incl. this one
+    assert info["threads"] and any(
+        "test_watchdog_fires_once" in "".join(stack)
+        for stack in info["threads"].values()
+    )
+    # single-shot: a second expiry does not fire (or exit) again
+    t["now"] = 200.0
+    assert not wd.check()
+    assert exits == [76]
+
+
+def test_watchdog_disarm_and_idle_hold():
+    wd, t, exits, _ = _wd(5.0)
+    wd.arm()
+    wd.disarm()
+    t["now"] = 100.0
+    assert not wd.check() and not exits  # disarmed: never fires
+    # poll mode: pending_fn falsy holds the clock reset — idle is not wedged
+    pend = {"pending": False}
+    prog = {"n": 0}
+    wd2, t2, exits2, _ = _wd(
+        5.0, pending_fn=lambda: pend["pending"], progress_fn=lambda: prog["n"]
+    )
+    wd2.arm()
+    t2["now"] = 100.0
+    assert not wd2.check()
+    # work appears; progress advances each poll: still healthy
+    pend["pending"] = True
+    for now in (103.0, 106.0, 109.0):
+        prog["n"] += 1
+        t2["now"] = now
+        assert not wd2.check()
+    # progress stalls with work pending: fires after the deadline
+    t2["now"] = 112.0
+    assert not wd2.check()  # 109 -> 112: only 3s stalled
+    t2["now"] = 114.2
+    assert wd2.check()
+    assert exits2 == [76]
+
+
+def test_watchdog_exit_code_and_on_wedge_exception_still_exits():
+    def boom(info):
+        raise RuntimeError("post-mortem bug")
+
+    t = {"now": 0.0}
+    exits = []
+    wd = HeartbeatWatchdog(
+        1.0, on_wedge=boom, clock=lambda: t["now"], exit_fn=exits.append,
+        poll_s=3600, exit_code=77,
+    )
+    wd.arm()
+    t["now"] = 2.5
+    assert wd.check()
+    assert exits == [77]  # a broken on_wedge must not turn rc into a zombie
+
+
+# ---------------------------------------------------------------------------
+# EventLog: flushed appends, closed handles, never-dropped late events
+# ---------------------------------------------------------------------------
+
+
+def test_event_log_flushes_and_survives_close(tmp_path):
+    log = EventLog(str(tmp_path))
+    log.append({"event": "a"})
+    # flushed immediately: another reader sees it before close
+    with open(log.path) as f:
+        assert json.loads(f.readline())["event"] == "a"
+    log.close()
+    log.close()  # idempotent
+    log.append({"event": "late"})  # after close: still lands, not dropped
+    with open(log.path) as f:
+        events = [json.loads(line)["event"] for line in f]
+    assert events == ["a", "late"]
+
+
+# ---------------------------------------------------------------------------
+# degraded mesh plan arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_degraded_mesh_plan_shrinks_dp_keeps_mp_or_falls_back():
+    # feasible: no plan
+    assert degraded_mesh_plan(ParallelConfig(dp=4, mp=2), 8, 8) is None
+    assert degraded_mesh_plan(ParallelConfig(dp=-1, mp=2), 8, 8) is None
+    # dp shrinks to the largest batch divisor that fits
+    assert degraded_mesh_plan(ParallelConfig(dp=4), 2, 4) == (2, 1)
+    assert degraded_mesh_plan(ParallelConfig(dp=8), 3, 8) == (2, 1)
+    # mp kept if it still fits; dp drops around it
+    assert degraded_mesh_plan(ParallelConfig(dp=4, mp=2), 4, 4) == (2, 2)
+    # mp larger than the device count collapses to 1; dp never grows past
+    # what the config asked for, even with devices freed by the collapse
+    assert degraded_mesh_plan(ParallelConfig(dp=1, mp=8), 2, 4) == (1, 1)
+    assert degraded_mesh_plan(ParallelConfig(dp=4, mp=8), 2, 4) == (2, 1)
+    # nothing divides: single-device fallback
+    assert degraded_mesh_plan(ParallelConfig(dp=4), 2, 3) == (1, 1)
+    assert degraded_mesh_plan(ParallelConfig(dp=2), 1, 2) == (1, 1)
+
+
+def test_runner_degrades_infeasible_mesh_in_process(toy_dataset, tmp_path):
+    """A config demanding more devices than visible (dp=16 on the 8-device
+    test platform) shrinks to the largest feasible dp instead of crashing,
+    logs the degraded_mesh event, and trains to completion."""
+    cfg = runner_config(
+        toy_dataset, tmp_path, experiment_name="toy_degraded16",
+        parallel=ParallelConfig(dp=16), total_epochs=1,
+    )
+    runner = ExperimentRunner(cfg, system=small_system(cfg))
+    assert runner.degraded_mesh == {
+        "requested": [16, 1], "granted": [2, 1], "visible_devices": 8,
+    }
+    assert runner.mesh is not None and runner.mesh.shape["dp"] == 2
+    result = runner.run_experiment()
+    assert "test_accuracy_mean" in result
+    with open(os.path.join(runner.run_dir, "logs", "events.jsonl")) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    degraded = [e for e in events if e.get("event") == "degraded_mesh"]
+    assert degraded and degraded[0]["granted"] == [2, 1]
+
+
+# ---------------------------------------------------------------------------
+# serving-side watchdog: a hung flush worker is restart-only
+# ---------------------------------------------------------------------------
+
+
+def test_serving_watchdog_detects_hung_flush_worker():
+    """A flush worker parked in a hung device dispatch with work pending:
+    the breaker fail-fasts clients but cannot un-hang the thread — the
+    serving watchdog must fire the wedge exit (injected here) after
+    serve_deadline_s of zero flush progress."""
+    import time
+
+    from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+    from howtotrainyourmamlpytorch_tpu.config import ServingConfig
+    from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+    from howtotrainyourmamlpytorch_tpu.models import build_vgg
+    from howtotrainyourmamlpytorch_tpu.resilience import FaultInjector
+    from howtotrainyourmamlpytorch_tpu.resilience.retry import DeadlineExceededError
+    from howtotrainyourmamlpytorch_tpu.serving import AdaptationEngine, ServingFrontend
+
+    img = (28, 28, 1)
+    cfg = Config(
+        num_classes_per_set=5,
+        num_samples_per_class=2,
+        num_target_samples=3,
+        batch_size=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        serving=ServingConfig(support_buckets=[16], query_buckets=[16]),
+    )
+    system = MAMLSystem(
+        cfg, model=build_vgg(img, cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4)
+    )
+    # dispatch 1 (warmup/compile) clean; dispatch 2 hangs for 3s
+    inj = FaultInjector.from_specs(
+        ["serving.dispatch=delay:delay_s=3.0,after=1,times=1"], include_env=False
+    )
+    engine = AdaptationEngine(system, system.init_train_state(), injector=inj)
+
+    def support(seed):
+        ep = synthetic_batch(1, 5, 2, 3, img, seed=seed)
+        return ep["x_support"][0], ep["y_support"][0]
+
+    exits = []
+    res = ResilienceConfig(
+        request_deadline_s=0.2,
+        watchdog=WatchdogConfig(serve_deadline_s=0.6, poll_s=0.05),
+    )
+    engine.adapt_batch([support(0)])  # warm: compile outside the drill (and
+    # outside the 0.2s request deadline a compile would blow through)
+    frontend = ServingFrontend(engine, resilience_cfg=res, wedge_exit=exits.append)
+    try:
+        with pytest.raises(DeadlineExceededError):
+            frontend.adapt(*support(1))  # worker now parked in the 3s hang
+        deadline = time.monotonic() + 5.0
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert exits == [76]
+        assert frontend.counters.get("wedged") == 1
+    finally:
+        frontend.close()
+
+
+# ---------------------------------------------------------------------------
+# the wedge drill: hung step -> stacks -> rc=76 -> exact resume
+# ---------------------------------------------------------------------------
+
+
+def _run_child(cfg, tmp_path, name, n_devices=8, timeout=300):
+    cfg_yaml = str(tmp_path / f"{name}.yaml")
+    save_config(cfg, cfg_yaml)
+    code = (
+        "import sys;"
+        "from howtotrainyourmamlpytorch_tpu.resilience.campaign "
+        "import child_train_main;"
+        "sys.exit(child_train_main(sys.argv[1]))"
+    )
+    return subprocess.run(
+        [sys.executable, "-c", code, cfg_yaml],
+        cwd=REPO,
+        env=_child_env(n_devices),
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def test_wedge_drill_rc76_stack_dump_exact_resume(toy_dataset, tmp_path):
+    """ISSUE 3 acceptance: an injected hung step (delay far past the
+    watchdog deadline) exits rc=76 with all-thread stacks in events.jsonl
+    and an emergency checkpoint; the subsequent resume matches an
+    uninterrupted control run exactly."""
+    # control: uninterrupted 2-epoch run, in-process
+    ctl_cfg = campaign_config(toy_dataset, str(tmp_path), "wedge_ctl")
+    ctl = ExperimentRunner(ctl_cfg, system=tiny_system(ctl_cfg))
+    ctl.run_experiment()
+
+    # wedged: dispatch 4 (epoch 1, iter 0) sleeps 120s; a 25s zero-progress
+    # deadline fires long before the sleep ends but still clears one
+    # cold-cache XLA compile, so the drill pins the injected hang — never a
+    # healthy compile
+    wedge_cfg = campaign_config(
+        toy_dataset, str(tmp_path), "wedge_run",
+        resilience=ResilienceConfig(
+            faults=["runner.step=delay:delay_s=120,nth=4"],
+            watchdog=WatchdogConfig(deadline_s=25.0, poll_s=0.5),
+        ),
+    )
+    proc = _run_child(wedge_cfg, tmp_path, "wedge_run")
+    assert proc.returncode == 76, (proc.stdout, proc.stderr)
+    assert "WEDGED" in proc.stdout
+
+    run_dir = os.path.join(str(tmp_path), "wedge_run")
+    with open(os.path.join(run_dir, "logs", "events.jsonl")) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    wedged = [e for e in events if e.get("event") == "wedged"]
+    assert wedged, [e.get("event") for e in events]
+    # the hung thread's stack pins the exact frame that never returned —
+    # here the injected delay's sleep inside the fault injector
+    stacks = wedged[0]["threads"]
+    assert stacks and any("fire" in "".join(s) for s in stacks.values())
+    assert wedged[0]["stall_s"] >= 25.0
+    assert any(e.get("event") == "wedge_checkpoint" for e in events)
+
+    # resume (clean config, default watchdog): epoch 0's checkpoint anchors
+    # the replay of the wedged epoch over the deterministic stream
+    resume_cfg = campaign_config(toy_dataset, str(tmp_path), "wedge_run")
+    resumed = ExperimentRunner(resume_cfg, system=tiny_system(resume_cfg))
+    assert resumed.start_epoch == 1  # epoch 0 completed before the wedge
+    resumed.run_experiment()
+
+    for a, b in zip(
+        jax.tree.leaves(ctl.state.params), jax.tree.leaves(resumed.state.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_dp4_checkpoint_resumes_and_trains_on_one_device(toy_dataset, tmp_path):
+    """ISSUE 3 acceptance: a checkpoint written under dp=4 resumes on 1
+    visible device (degraded_mesh event, single-device fallback), evaluates
+    within tolerance of the dp=4 eval of the same state, and keeps
+    training."""
+    base = dict(batch_size=4, parallel=ParallelConfig(dp=4), total_epochs=1)
+    cfg = campaign_config(toy_dataset, str(tmp_path), "shrink_run", **base)
+    runner = ExperimentRunner(cfg, system=tiny_system(cfg))
+    assert runner.mesh is not None and runner.mesh.shape["dp"] == 4
+    runner.run_experiment()
+
+    # reference eval: a fresh dp=4 runner restores the checkpoint and
+    # evaluates val on the full mesh
+    ref = ExperimentRunner(cfg, system=tiny_system(cfg))
+    try:
+        assert ref.start_epoch == 1
+        ref_val = ref._eval_split("val")
+    finally:
+        ref.loader.close()
+
+    # child on ONE visible device: must resume the same checkpoint through
+    # the degraded path, report matching eval, and train an extra epoch
+    child_cfg = campaign_config(
+        toy_dataset, str(tmp_path), "shrink_run", **{**base, "total_epochs": 2}
+    )
+    cfg_yaml = str(tmp_path / "shrink_resume.yaml")
+    save_config(child_cfg, cfg_yaml)
+    code = (
+        "import sys, json;"
+        "from howtotrainyourmamlpytorch_tpu.resilience.campaign import "
+        "child_train_main, campaign_config, tiny_system;"
+        "from howtotrainyourmamlpytorch_tpu.config import load_config;"
+        "from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner;"
+        "cfg = load_config(sys.argv[1]);"
+        "r = ExperimentRunner(cfg, system=tiny_system(cfg));"
+        "assert r.start_epoch == 1, r.start_epoch;"
+        "assert r.degraded_mesh is not None, 'expected a degraded mesh';"
+        "val = r._eval_split('val');"
+        "r.run_experiment();"
+        "print('CHILD_JSON ' + json.dumps({'val': val, "
+        "'degraded': r.degraded_mesh}))"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, cfg_yaml],
+        cwd=REPO,
+        env=_child_env(1),
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = next(
+        json.loads(line.split(" ", 1)[1])
+        for line in proc.stdout.splitlines()
+        if line.startswith("CHILD_JSON ")
+    )
+    assert payload["degraded"] == {
+        "requested": [4, 1], "granted": [1, 1], "visible_devices": 1,
+    }
+    # same restored state, same fixed eval stream: parity within numeric
+    # tolerance (single-device vs dp=4 differ only in reduction layout)
+    assert payload["val"]["val_num_episodes"] == ref_val["val_num_episodes"]
+    np.testing.assert_allclose(
+        payload["val"]["val_accuracy_mean"],
+        ref_val["val_accuracy_mean"],
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        payload["val"]["val_loss_mean"], ref_val["val_loss_mean"], rtol=1e-5
+    )
+    # the degraded event landed in the shared run dir, and the extra epoch
+    # actually trained (a second epoch row exists)
+    run_dir = os.path.join(str(tmp_path), "shrink_run")
+    with open(os.path.join(run_dir, "logs", "events.jsonl")) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    assert any(e.get("event") == "degraded_mesh" for e in events)
+    import csv
+
+    with open(os.path.join(run_dir, "logs", "summary_statistics.csv")) as f:
+        rows = list(csv.DictReader(f))
+    assert {int(float(r["epoch"])) for r in rows} == {0, 1}
