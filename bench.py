@@ -29,6 +29,21 @@ import time
 # must be set before any protobuf import (xplane parsing, utils/profiling.py)
 os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 
+# scripts/ on the path up front: _fail needs the (jax-free, file-path-loaded)
+# exit-code registry from wait_for_tpu before any backend contact. Resolved
+# HERE, with a fallback, because _fail is the guaranteed one-JSON-line
+# failure reporter — the failure path must not grow an import failure mode
+# (a partial artifact copy without scripts/ beside it must still emit JSON).
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+)
+try:
+    from wait_for_tpu import exit_codes as _exit_codes
+
+    _RC_USAGE = _exit_codes.USAGE
+except Exception:  # registry unreadable: the historical literal still holds
+    _RC_USAGE = 2
+
 REFERENCE_STEPS_PER_SEC = 2.6  # fastest plausible single-GPU reference (see docstring)
 STARTUP_TIMEOUT_S = float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", 90.0))
 # The axon tunnel wedges for minutes-to-hours at a time (server-side). A
@@ -49,7 +64,9 @@ _PEAK_FLOPS = [
 ]
 
 
-def _fail(msg: str, rc: int = 2) -> None:
+def _fail(msg: str, rc: int = None) -> None:
+    if rc is None:
+        rc = _RC_USAGE
     print(
         json.dumps(
             {
@@ -80,9 +97,6 @@ def _wait_for_backend(deadline_s: float) -> None:
     times) emits the structured-failure JSON line IMMEDIATELY and exits;
     a mixed-failure deadline expiry falls through and lets the in-process
     contact produce the structured failure, as before."""
-    sys.path.insert(
-        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
-    )
     from wait_for_tpu import wait_for_backend
 
     max_wedged = int(os.environ.get("BENCH_MAX_WEDGED_PROBES", "5"))

@@ -17,6 +17,8 @@ from typing import Any, Dict, List, Optional
 
 import yaml
 
+from . import exit_codes
+
 # ---------------------------------------------------------------------------
 
 
@@ -154,7 +156,7 @@ class WatchdogConfig:
     deadline_s: float = 900.0
     # supervisor poll period; 0 = auto (deadline/10 clamped to [0.02s, 5s])
     poll_s: float = 0.0
-    wedge_exit_code: int = 76
+    wedge_exit_code: int = exit_codes.WEDGED
     # serving-side supervision of the batcher flush workers: a flush that
     # hangs in device dispatch past serve_deadline_s with work queued behind
     # it exits wedge_exit_code so a supervisor restarts the server (the
@@ -172,12 +174,16 @@ class WatchdogConfig:
                 f"resilience.watchdog.serve_deadline_s must be > 0, "
                 f"got {self.serve_deadline_s}"
             )
-        if not 1 <= self.wedge_exit_code <= 125 or self.wedge_exit_code in (3, 75):
-            # 3 = permanent divergence, 75 = preemption: reusing either would
-            # make the sweep misclassify a wedge
+        if not 1 <= self.wedge_exit_code <= 125 or self.wedge_exit_code in (
+            exit_codes.DIVERGED,
+            exit_codes.PREEMPTED,
+        ):
+            # reusing the divergence or preemption code would make the sweep
+            # misclassify a wedge
             raise ValueError(
                 "resilience.watchdog.wedge_exit_code must be in [1, 125] and "
-                f"distinct from 3/75, got {self.wedge_exit_code}"
+                f"distinct from {exit_codes.DIVERGED}/{exit_codes.PREEMPTED}, "
+                f"got {self.wedge_exit_code}"
             )
 
 
@@ -212,7 +218,7 @@ class ResilienceConfig:
     # iteration cursor, then exit with preemption_exit_code (75 =
     # EX_TEMPFAIL) — scripts/sweep.sh restarts it without burning an attempt
     preemption_save: bool = True
-    preemption_exit_code: int = 75
+    preemption_exit_code: int = exit_codes.PREEMPTED
     # --- loader transient-I/O retry (data/loader.py) ---
     loader_io_retries: int = 2
     loader_io_backoff_s: float = 0.05
@@ -421,6 +427,14 @@ class Config:
     # inner step instead of one elementwise op per leaf. Identical math
     # (custom VJP; parity-tested). SGD/gd inner optimizer only.
     use_pallas_inner_update: bool = False
+    # Strict recompile guard (utils/strictmode.py::RecompileGuard): declare
+    # the compiled program families up front (train-step variants, serving
+    # shape/batch buckets) and RAISE on any lowering outside them, instead
+    # of silently eating an XLA compile mid-run. Off by default (oversize
+    # serving requests legitimately compile exact shapes on demand); turn on
+    # in tests and perf-sensitive deployments where an unplanned recompile
+    # is a bug, not a convenience.
+    strict_recompile_guard: bool = False
     profile_dir: str = ""  # non-empty: write jax.profiler traces here
     # XLA matmul/conv precision for f32 operands. On TPU the "default" is a
     # single bfloat16 MXU pass (8-bit mantissa) even when tensors are f32 —

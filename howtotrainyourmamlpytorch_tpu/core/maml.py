@@ -183,8 +183,23 @@ class MAMLSystem:
         # num_steps target forwards when only the last one counts.
         self._train_step_cache = {}
         self._train_multi_cache = {}
+        # strict mode: every lowering is noted against the declared train
+        # program family BEFORE jit is invoked, so an unplanned variant
+        # raises instead of silently paying an XLA compile mid-run
+        self.recompile_guard = None
+        if cfg.strict_recompile_guard:
+            from ..utils.strictmode import RecompileGuard, train_planned_programs
+
+            self.recompile_guard = RecompileGuard(
+                planned=train_planned_programs(cfg), name="maml-system"
+            )
+        self._note_program(("eval",))
         self._eval_step = jax.jit(self._eval_step_impl)
         self._eval_multi = None
+
+    def _note_program(self, key) -> None:
+        if self.recompile_guard is not None:
+            self.recompile_guard.note(key)
 
     # ------------------------------------------------------------------
     # state
@@ -240,6 +255,11 @@ class MAMLSystem:
         self.outer_opt = optax.adam(learning_rate=self.schedule)
         self._train_step_cache.clear()
         self._train_multi_cache.clear()
+        if self.recompile_guard is not None:
+            # a deliberate cache drop re-plans the same family: the variants
+            # recompiled against the new schedule are not violations
+            self.recompile_guard.reset()
+        self._note_program(("eval",))  # re-jitted below: count the lowering
         self._eval_step = jax.jit(self._eval_step_impl)
         self._eval_multi = None
 
@@ -582,6 +602,7 @@ class MAMLSystem:
     def _compiled_train_step(self, second_order: bool, msl_active: bool):
         key = (second_order, msl_active)
         if key not in self._train_step_cache:
+            self._note_program(("train",) + key)
             donate = (0,) if self.cfg.donate_train_state else ()
             self._train_step_cache[key] = jax.jit(
                 functools.partial(
@@ -672,6 +693,7 @@ class MAMLSystem:
     def _compiled_train_multi(self, second_order: bool, msl_active: bool):
         key = (second_order, msl_active)
         if key not in self._train_multi_cache:
+            self._note_program(("train_multi",) + key)
             donate = (0,) if self.cfg.donate_train_state else ()
             self._train_multi_cache[key] = jax.jit(
                 functools.partial(
@@ -719,5 +741,6 @@ class MAMLSystem:
         config's 600 tasks / batch 8). Returns
         ``(per_task_losses [N, B], per_task_accuracies [N, B])``."""
         if self._eval_multi is None:
+            self._note_program(("eval_multi",))
             self._eval_multi = jax.jit(self._eval_multi_impl)
         return self._eval_multi(state, batches)

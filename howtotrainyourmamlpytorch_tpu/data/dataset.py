@@ -131,8 +131,10 @@ class FewShotDataset:
 
             with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
                 list(pool.map(load_class, classes.items()))
-            self.datasets[split] = views
-            self.packed[split] = (buffer, offsets)
+            # one-shot init on the calling thread: pool.map has already
+            # joined the decode workers when these cache writes run
+            self.datasets[split] = views  # graftlint: disable=GL201
+            self.packed[split] = (buffer, offsets)  # graftlint: disable=GL201
         self.in_memory = True
 
     # ------------------------------------------------------------------

@@ -21,6 +21,7 @@ nearly-identical episode streams across epochs. We advance the cursor per
 """
 
 import concurrent.futures
+import threading
 import weakref
 from typing import Dict, Iterator, Optional
 
@@ -71,7 +72,12 @@ class MetaLearningDataLoader:
             self._local_lo, self._local_hi = 0, self.batch_size
         self.num_workers = max(cfg.num_dataprovider_workers, 1)
         self._injector = injector
-        self.io_retries_used = 0  # transient episode-I/O retries (observability)
+        # transient episode-I/O retries (observability). Retry callbacks run
+        # on the prefetch-window pool threads — two in-flight batch builds
+        # can retry concurrently, so the counter increments under a lock
+        # (graftlint GL201: the lost-update shape)
+        self._stats_lock = threading.Lock()
+        self.io_retries_used = 0
         self.train_episodes_produced = 0
         self.continue_from_iter(current_iter)
         # persistent episode-assembly pool: one per loader, not per batch —
@@ -149,7 +155,8 @@ class MetaLearningDataLoader:
             return _stack(episodes)
 
         def note_retry(attempt_idx, exc):
-            self.io_retries_used += 1
+            with self._stats_lock:
+                self.io_retries_used += 1
             print(
                 f"warning: episode I/O failed ({exc}); retry "
                 f"{attempt_idx + 1}/{res.loader_io_retries}",
@@ -175,10 +182,17 @@ class MetaLearningDataLoader:
         ahead = self._window_pool
         futures = {i: ahead.submit(build, i) for i in range(min(window, total))}
         for i in range(total):
+            # untimed on purpose: a batch build has no sane fixed budget (cold
+            # NFS, huge ways) and a truly hung build is the runner wedge
+            # watchdog's job — it rc=76s the process with stacks rather than
+            # guessing a timeout here  # graftlint: disable=GL202
             item = futures.pop(i).result()
             nxt = i + window
             if nxt < total:
                 futures[nxt] = ahead.submit(build, nxt)
+            # consumer-thread only: the generator body runs on the single
+            # iterating thread; pool threads never touch this cursor
+            # graftlint: disable=GL201
             self.train_episodes_produced += advance_per_yield
             yield item
 

@@ -1,0 +1,81 @@
+"""Single source of truth for the process exit-code contract.
+
+Every harness decision in this repo keys off a small set of process exit
+codes (``scripts/sweep.sh`` restart policy, the chaos-campaign invariants,
+the driver's rc classification) plus two serving-side HTTP degradation
+statuses. Before this module they were scattered as bare literals across
+five files and one markdown table, each free to drift; now the literals
+live HERE and everything else imports them. The ``graftlint`` contract
+rules enforce it statically: GL301 flags any bare registry literal at an
+exit site, and GL302 cross-checks the ``docs/OPERATIONS.md`` rc table
+against :data:`TRAIN_PROCESS_RCS`.
+
+Deliberately dependency-free (no jax, no package-relative imports): scripts
+that must stay import-light before the backend is known-up
+(``scripts/wait_for_tpu.py``, ``bench.py``) load this module directly by
+file path instead of importing the (heavy) package.
+"""
+
+# --- generic CLI codes ----------------------------------------------------
+#: completed / all invariants held
+OK = 0
+#: generic CLI usage / structured-failure code (argparse convention)
+USAGE = 2
+
+# --- training-process codes (the sweep.sh restart policy) -----------------
+#: permanent divergence: NaN-rollback ladder exhausted or early-abort
+#: tripped. Retrying resumes the same collapsing trajectory — do NOT retry.
+DIVERGED = 3
+#: preemption (SIGTERM/SIGINT): emergency checkpoint with a mid-epoch
+#: cursor was written; restart resumes exactly (EX_TEMPFAIL).
+PREEMPTED = 75
+#: wedge watchdog: zero progress past the deadline; thread stacks are in
+#: logs/events.jsonl and an emergency checkpoint from the last settled
+#: state was written. Restart free, but gate on the tunnel first.
+WEDGED = 76
+#: legacy: an *outer* ``timeout`` killed a hung process that had no
+#: watchdog. Documented so old logs stay readable; should no longer occur.
+LEGACY_TIMEOUT_KILL = 124
+
+# --- TPU wait-gate codes (scripts/wait_for_tpu.py) ------------------------
+#: the backend never came up inside --deadline-s (mixed probe failures)
+TPU_WAIT_DEADLINE = 64
+#: K consecutive probes hung — the dead-tunnel signature; gave up early
+TPU_WAIT_WEDGED = 65
+
+# --- serving HTTP degradation codes (serving/server.py) -------------------
+#: load shed (queue full) or circuit breaker open — sent with Retry-After
+HTTP_UNAVAILABLE = 503
+#: one request ran past resilience.request_deadline_s
+HTTP_DEADLINE = 504
+
+# --- derived sets ---------------------------------------------------------
+#: what a training process may legitimately exit with (the chaos-campaign
+#: rc-discipline invariant; anything else is an undocumented failure mode)
+DOCUMENTED_RCS = (OK, DIVERGED, PREEMPTED, WEDGED)
+#: restart-not-fail codes: both are backed by an emergency checkpoint and
+#: sweep.sh relaunches them without burning a watchdog attempt
+RESTARTABLE_RCS = (PREEMPTED, WEDGED)
+
+#: the docs/OPERATIONS.md "Exit-code table" rows, one meaning per code —
+#: GL302 asserts the markdown table and this dict never drift
+TRAIN_PROCESS_RCS = {
+    OK: "completed",
+    DIVERGED: "permanent divergence (NaN ladder exhausted / early abort)",
+    PREEMPTED: "preemption: emergency checkpoint + mid-epoch cursor",
+    WEDGED: "wedged: watchdog saw zero progress past the deadline",
+    LEGACY_TIMEOUT_KILL: "legacy outer-timeout kill (pre-watchdog)",
+}
+
+
+def describe(rc: int) -> str:
+    """Human label for a process exit code (unknown codes say so)."""
+    if rc in TRAIN_PROCESS_RCS:
+        return TRAIN_PROCESS_RCS[rc]
+    if rc == TPU_WAIT_DEADLINE:
+        return "TPU wait gate: deadline exceeded"
+    if rc == TPU_WAIT_WEDGED:
+        return "TPU wait gate: consecutive probes hung (dead tunnel)"
+    if rc == USAGE:
+        return "usage / structured failure"
+    return f"undocumented exit code {rc}"

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import exit_codes
 from ..config import Config, save_config
 from ..core import MAMLSystem, TrainState
 from ..data import FewShotDataset, MetaLearningDataLoader
@@ -346,6 +347,9 @@ class ExperimentRunner:
             nonlocal pending
             state_before, loss_dev, acc_dev, forced = pending
             pending = None
+            # deliberate sync: the sentinel's one-dispatch-lag loss check IS
+            # a host fetch — one scalar per settled step, while dispatch i+1
+            # is already in flight  # graftlint: disable=GL110
             loss_host = np.atleast_1d(np.asarray(jax.device_get(loss_dev)))
             # the fetch above is where a wedged device call hangs first —
             # completing it is the strongest liveness evidence there is
@@ -354,6 +358,8 @@ class ExperimentRunner:
                 self.state = state_before
                 return False
             losses.append(loss_host)
+            # already settled by the loss fetch above; this adds no new sync
+            # graftlint: disable=GL110
             accs.append(np.atleast_1d(np.asarray(jax.device_get(acc_dev))))
             # a good step breaks the streak: the K threshold counts
             # CONSECUTIVE discards, not discards-since-last-rollback —
@@ -413,6 +419,9 @@ class ExperimentRunner:
                 )
                 self._beat(f"dispatch epoch {epoch}")
                 if profile_this_epoch and it == prof_stop - 1:
+                    # drain before stop_trace so the profiled window captures
+                    # complete steps; profiling epochs only
+                    # graftlint: disable=GL110
                     out.loss.block_until_ready()
                     jax.profiler.stop_trace()
                     self._profiled = True
@@ -433,7 +442,9 @@ class ExperimentRunner:
             self._emergency_exit(epoch, undispatched=undispatched_iters)
         # one bulk fetch instead of 2*iters scalar device_gets (each a
         # round-trip when the chip sits behind a network tunnel); with the
-        # guard on, entries are already host arrays and this is a no-op
+        # guard on, entries are already host arrays and this is a no-op —
+        # runs once per epoch, after the dispatch loop
+        # graftlint: disable=GL110
         losses, accs = jax.device_get((losses, accs))
         losses = np.concatenate([np.atleast_1d(x) for x in losses] or [np.zeros(0)])
         accs = np.concatenate([np.atleast_1d(x) for x in accs] or [np.zeros(0)])
@@ -448,6 +459,8 @@ class ExperimentRunner:
             "train_loss_std": loss_std,
             "train_accuracy_mean": acc_mean,
             "train_accuracy_std": acc_std,
+            # once per epoch, after the loop: everything is already settled
+            # graftlint: disable=GL110
             "learning_rate": float(lr),
             "epoch_run_time": time.time() - start,
         }
@@ -570,7 +583,7 @@ class ExperimentRunner:
             storage.change_json_log_experiment_status(
                 self.logs_dir, self.experiment_name, msg
             )
-            raise SystemExit(3)
+            raise SystemExit(exit_codes.DIVERGED)
         self._rollbacks += 1
         self._bad_steps = 0
         self.state = self._place_state(self._last_good)
@@ -951,7 +964,7 @@ class ExperimentRunner:
                 storage.change_json_log_experiment_status(
                     self.logs_dir, self.experiment_name, msg
                 )
-                raise SystemExit(3)
+                raise SystemExit(exit_codes.DIVERGED)
         self.load_best()
         test_stats = self.evaluate_test()
         return {

@@ -43,10 +43,12 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-#: Exit codes with documented semantics (docs/OPERATIONS.md rc table):
-#: 0 = completed, 3 = permanent divergence (NaN ladder exhausted / early
-#: abort), 75 = preemption emergency checkpoint, 76 = wedge watchdog.
-DOCUMENTED_RCS = (0, 3, 75, 76)
+from .. import exit_codes
+
+#: Exit codes with documented semantics (docs/OPERATIONS.md rc table) — the
+#: rc-discipline invariant checks against the central registry, so a new code
+#: added there is automatically accepted (and documented) here.
+DOCUMENTED_RCS = exit_codes.DOCUMENTED_RCS
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -167,14 +169,14 @@ def episode_menu(rng: np.random.RandomState) -> List[Episode]:
             mode="train",
             faults=["runner.step=nan-loss:p=1.0"],
             resilience_overrides=dict(max_consecutive_bad_steps=1, max_rollbacks=1),
-            expected_rcs=(3,),
+            expected_rcs=(exit_codes.DIVERGED,),
             required_events=("nan_rollback", "nan_abort"),
         ),
         Episode(
             kind="sigterm-preempt",
             mode="train",
             faults=[f"runner.step=sigterm:nth={nth(2, 4)}"],
-            expected_rcs=(75,),
+            expected_rcs=(exit_codes.PREEMPTED,),
             resume_after=True,
             required_events=("preempted",),
         ),
@@ -195,7 +197,7 @@ def episode_menu(rng: np.random.RandomState) -> List[Episode]:
             kind="wedge-hung-step",
             mode="train",
             faults=[f"runner.step=delay:delay_s=60,nth={nth(2, 5)}"],
-            expected_rcs=(76,),
+            expected_rcs=(exit_codes.WEDGED,),
             subprocess=True,
             resume_after=True,
             required_events=("wedged", "wedge_checkpoint"),
@@ -575,7 +577,7 @@ def run_campaign(
             if ep.mode == "train":
                 fault_rc = _run(faulted, ep.subprocess)
                 rcs.append(fault_rc)
-                if ep.resume_after or fault_rc in (75, 76):
+                if ep.resume_after or fault_rc in exit_codes.RESTARTABLE_RCS:
                     # clean resume leg: the faulted run must have left a
                     # resumable run dir behind
                     rcs.append(_run(base, False))

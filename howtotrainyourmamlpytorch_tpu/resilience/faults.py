@@ -65,6 +65,21 @@ import numpy as np
 
 KINDS = ("raise", "corrupt-bytes", "nan-loss", "delay", "sigterm")
 
+#: The registered seam names — the single source of truth for everything
+#: that fires or drills a fault site. ``graftlint`` GL303 statically checks
+#: every ``fire("...")`` call site and every fault-spec string in the tree
+#: against this tuple, so a typo'd drill (which would silently never fire)
+#: is a lint error, not a no-op soak. Add the seam HERE (with its docstring
+#: row above) before wiring a new ``fire()`` call.
+SEAMS = (
+    "checkpoint.write",
+    "checkpoint.read",
+    "loader.episode",
+    "runner.step",
+    "serving.dispatch",
+    "serving.http",
+)
+
 # env var merged into every config-built injector: drills on a live run
 # without editing its config (docs/OPERATIONS.md "Drilling faults")
 ENV_VAR = "HTYMP_FAULTS"

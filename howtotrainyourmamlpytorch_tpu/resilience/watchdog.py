@@ -47,9 +47,12 @@ import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional
 
-#: The wedge exit code's contract (mirrors 75/EX_TEMPFAIL for preemption):
-#: restartable, but the harness should gate on the backend before relaunch.
-WEDGE_EXIT_CODE = 76
+from .. import exit_codes
+
+#: The wedge exit code's contract (mirrors PREEMPTED/EX_TEMPFAIL): restartable,
+#: but the harness should gate on the backend before relaunch. Single source
+#: of truth: ``exit_codes.WEDGED``; re-exported here for existing callers.
+WEDGE_EXIT_CODE = exit_codes.WEDGED
 
 
 def dump_all_thread_stacks() -> Dict[str, List[str]]:

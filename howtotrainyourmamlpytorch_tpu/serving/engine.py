@@ -68,6 +68,7 @@ class AdaptationEngine:
         serving_cfg: Optional[ServingConfig] = None,
         fingerprint: Optional[str] = None,
         injector=None,
+        strict: Optional[bool] = None,
     ):
         self.system = system
         self.cfg = system.cfg
@@ -103,6 +104,19 @@ class AdaptationEngine:
         self._adapt_jit: Dict[Tuple[int, int], Any] = {}
         self._predict_jit: Dict[Tuple[int, int], Any] = {}
         self._jit_lock = threading.Lock()
+        # strict mode (Config.strict_recompile_guard / explicit ``strict=``):
+        # the bucket tables declare the whole program family up front; a
+        # request that would compile outside it (an oversize support/query
+        # set slipping past the buckets) raises instead of silently paying
+        # an XLA compile on the serving hot path.
+        self.recompile_guard = None
+        strict = self.cfg.strict_recompile_guard if strict is None else strict
+        if strict:
+            from ..utils.strictmode import RecompileGuard, serving_planned_programs
+
+            self.recompile_guard = RecompileGuard(
+                planned=serving_planned_programs(self.serving), name="serving-engine"
+            )
 
     # ------------------------------------------------------------------
     # construction from a run directory
@@ -139,6 +153,8 @@ class AdaptationEngine:
         with self._jit_lock:
             fn = self._adapt_jit.get(key)
             if fn is None:
+                if self.recompile_guard is not None:
+                    self.recompile_guard.note(("adapt",) + key)
                 system, state, num_steps = self.system, self.state, self.num_steps
 
                 def adapt_batched(xs, ys, ws):
@@ -156,6 +172,8 @@ class AdaptationEngine:
         with self._jit_lock:
             fn = self._predict_jit.get(key)
             if fn is None:
+                if self.recompile_guard is not None:
+                    self.recompile_guard.note(("predict",) + key)
                 system, bn_state = self.system, self.state.bn_state
 
                 def predict_batched(fw, xs, ws):
@@ -167,12 +185,15 @@ class AdaptationEngine:
                 fn = self._predict_jit[key] = jax.jit(predict_batched)
         return fn
 
-    def compile_counts(self) -> Dict[str, int]:
+    def compile_counts(self) -> Dict[str, Any]:
         with self._jit_lock:
-            return {
+            out: Dict[str, Any] = {
                 "adapt_programs": len(self._adapt_jit),
                 "predict_programs": len(self._predict_jit),
             }
+        if self.recompile_guard is not None:
+            out["recompile_guard"] = self.recompile_guard.snapshot()
+        return out
 
     # ------------------------------------------------------------------
     # request padding
@@ -233,6 +254,8 @@ class AdaptationEngine:
         ``(fast_weights, x_query)``; returns per-item softmax probabilities
         [Q_i, num_classes] as host arrays, padding sliced off."""
         self.injector.fire("serving.dispatch")
+        # parses host-side request payloads (JSON-decoded lists), not device
+        # values  # graftlint: disable=GL110
         queries = [np.asarray(x, np.float32) for _, x in items]
         sizes = [q.shape[0] for q in queries]
         bucket = self.query_bucket(max(sizes))
@@ -248,6 +271,9 @@ class AdaptationEngine:
             xs.append(xs[-1]); ws.append(ws[-1]); trees.append(trees[-1])
         stacked_fw = jax.tree.map(lambda *leaves: jnp.stack(leaves), *trees)
         fn = self._compiled_predict(bucket, b)
+        # deliberate sync: predictions must land host-side to serialize back
+        # to clients — this is the flush's one device round-trip
+        # graftlint: disable=GL110
         probs = np.asarray(fn(stacked_fw, np.stack(xs), np.stack(ws)))
         return [probs[i, : sizes[i]] for i in range(n)]
 

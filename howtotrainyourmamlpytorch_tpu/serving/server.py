@@ -32,6 +32,7 @@ import jax
 import numpy as np
 
 from ..config import Config, ResilienceConfig, ServingConfig
+from ..exit_codes import HTTP_DEADLINE, HTTP_UNAVAILABLE
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.retry import DeadlineExceededError
 from ..resilience.watchdog import HeartbeatWatchdog
@@ -350,7 +351,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # receiving traffic (probes are real requests) or the
                 # breaker could never close — the body still says exactly
                 # what is degraded
-                code = 503 if "breaker_open" in health["degraded"] else 200
+                code = HTTP_UNAVAILABLE if "breaker_open" in health["degraded"] else 200
                 self._send_json(code, health)
             elif self.path == "/metrics":
                 self._send_json(200, frontend.metrics())
@@ -385,14 +386,14 @@ class _Handler(BaseHTTPRequestHandler):
         except ServiceUnavailableError as exc:
             # load shed / breaker open: tell the client when to come back
             self._send_json(
-                503,
+                HTTP_UNAVAILABLE,
                 {"error": str(exc), "retry_after_s": exc.retry_after_s},
                 # Retry-After is integer seconds (RFC 9110); round up so a
                 # sub-second hint doesn't become an immediate retry storm
                 headers={"Retry-After": str(max(1, int(round(exc.retry_after_s))))},
             )
         except DeadlineExceededError as exc:
-            self._send_json(504, {"error": str(exc)})
+            self._send_json(HTTP_DEADLINE, {"error": str(exc)})
         except UnknownAdaptationError as exc:
             self._send_json(404, {"error": str(exc)})
         except (KeyError, ValueError, TypeError) as exc:

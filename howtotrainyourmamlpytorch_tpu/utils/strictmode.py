@@ -1,0 +1,274 @@
+"""Strict mode: assert the compiled-program families stay within budget.
+
+The linter (``tools/graftlint``) catches recompile *hazards* statically;
+this module catches recompiles *at runtime*. The framework's performance
+story rests on small, closed program families — the runner's train-step
+variants keyed by ``(second_order, msl_active)`` and the serving engine's
+``(shape bucket, task-batch bucket)`` grid. Any program compiled outside
+the declared family is a silent perf cliff (XLA compiles are seconds to
+minutes behind the tunnel), invisible until someone reads ``/metrics``.
+:class:`RecompileGuard` makes it loud: a lowering for an unplanned key (or
+one past the count budget) raises :class:`RecompileBudgetExceededError`
+immediately, with the offending signature in the message.
+
+Enabled via ``Config.strict_recompile_guard`` (wired into ``MAMLSystem``
+and ``AdaptationEngine``), or used directly as a context manager in tests::
+
+    with RecompileGuard(budget=2, name="adapt") as guard:
+        fn = guard.wrap(jax.jit(adapt))
+        fn(small_batch); fn(small_batch)   # one lowering
+        fn(big_batch)                      # second lowering — at budget
+        fn(odd_batch)                      # third — raises
+
+``wrap`` counts lowerings by abstract argument signature (shape/dtype of
+every array leaf + the value of hashable non-array args) and cross-checks
+``jitted._cache_size()`` where this jax exposes it, so weak-type or
+static-arg cache misses the signature can't see are still caught.
+"""
+
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+
+class RecompileBudgetExceededError(RuntimeError):
+    """A program family grew past its declared budget (or off its planned
+    key set) — an unplanned XLA recompile."""
+
+
+def abstract_signature(value: Any) -> Any:
+    """Hashable (shape, dtype)-level abstraction of a call argument: two
+    arguments with equal signatures reuse one compiled program under jit."""
+    if hasattr(value, "shape") and hasattr(value, "dtype"):
+        return ("arr", tuple(value.shape), str(value.dtype))
+    if isinstance(value, dict):
+        return (
+            "dict",
+            tuple(sorted((k, abstract_signature(v)) for k, v in value.items())),
+        )
+    if isinstance(value, (list, tuple)):
+        kind = "list" if isinstance(value, list) else "tuple"
+        return (kind, tuple(abstract_signature(v) for v in value))
+    # NamedTuple-ish pytree nodes (TrainState, optax states)
+    if hasattr(value, "_fields"):
+        return (
+            type(value).__name__,
+            tuple(abstract_signature(getattr(value, f)) for f in value._fields),
+        )
+    try:
+        hash(value)
+        return ("static", value)
+    except TypeError:
+        return ("opaque", type(value).__name__)
+
+
+class RecompileGuard:
+    """Count lowerings against a declared program-family budget.
+
+    ``planned`` (optional): the exact set of allowed program keys — any
+    ``note()`` outside it raises immediately. ``budget`` (optional): a cap
+    on the number of distinct programs. Either alone works; together the
+    planned set is checked first. ``strict=False`` records violations in
+    ``.violations`` instead of raising (observe-only mode).
+    """
+
+    def __init__(
+        self,
+        budget: Optional[int] = None,
+        planned: Optional[Iterable[Any]] = None,
+        name: str = "jit",
+        strict: bool = True,
+    ):
+        if budget is None and planned is None:
+            raise ValueError("RecompileGuard needs a budget, a planned set, or both")
+        self.name = name
+        self.strict = strict
+        self.planned: Optional[Set[Any]] = set(planned) if planned is not None else None
+        self.budget = (
+            int(budget)
+            if budget is not None
+            else len(self.planned)  # type: ignore[arg-type]
+        )
+        self._lock = threading.Lock()
+        self._seen: List[Any] = []
+        # violating key -> message: a rejected key is NOT recorded as seen,
+        # so a retried unplanned request re-raises instead of slipping past
+        # the guard into an XLA compile on the second attempt
+        self._rejected: Dict[Any, str] = {}
+        self.violations: List[str] = []
+
+    # ------------------------------------------------------------------
+
+    @property
+    def lowerings(self) -> int:
+        with self._lock:
+            return len(self._seen)
+
+    def note(self, key: Any) -> None:
+        """Record that a program was (or is about to be) lowered for ``key``.
+        Idempotent per accepted key; an unplanned/over-budget key raises —
+        and keeps raising on every retry of the same key (it is never
+        accepted, so a client hammering an oversize request can't wear the
+        guard down into compiling)."""
+        with self._lock:
+            try:
+                if key in self._rejected:
+                    msg: Optional[str] = self._rejected[key]
+                elif key in self._seen:
+                    return
+                else:
+                    msg = None
+            except TypeError:  # unhashable key: fall back to the seen list
+                if key in self._seen:
+                    return
+                msg = None
+            if msg is None:
+                problem = None
+                if self.planned is not None and key not in self.planned:
+                    problem = (
+                        f"unplanned program {key!r} (planned family: "
+                        f"{sorted(map(repr, self.planned))})"
+                    )
+                elif len(self._seen) + 1 > self.budget:
+                    problem = (
+                        f"program {key!r} is lowering "
+                        f"#{len(self._seen) + 1} against a budget of "
+                        f"{self.budget}"
+                    )
+                if problem is None:
+                    self._seen.append(key)
+                    return
+                msg = f"RecompileGuard[{self.name}]: {problem}"
+                try:
+                    self._rejected[key] = msg
+                except TypeError:
+                    pass
+                self.violations.append(msg)
+        if self.strict:
+            raise RecompileBudgetExceededError(msg)
+
+    def reset(self) -> None:
+        """Forget seen programs (a deliberate cache drop, e.g. the rollback
+        LR backoff rebuilding the optimizer, re-plans the same family)."""
+        with self._lock:
+            self._seen.clear()
+            self._rejected.clear()
+
+    def check(self) -> None:
+        """Raise if any violation was recorded (useful with strict=False)."""
+        with self._lock:
+            violations = list(self.violations)
+        if violations:
+            raise RecompileBudgetExceededError("; ".join(violations))
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "name": self.name,
+                "budget": self.budget,
+                "lowerings": len(self._seen),
+                "violations": list(self.violations),
+            }
+
+    # ------------------------------------------------------------------
+
+    def wrap(self, fn: Callable, key_fn: Optional[Callable] = None) -> Callable:
+        """Wrap a jitted callable: each call computes the abstract signature
+        of its arguments and ``note()``s new ones; where the jitted function
+        exposes ``_cache_size()`` the true lowering count is cross-checked,
+        so a cache miss the signature abstraction can't see still trips."""
+        cache_size = getattr(fn, "_cache_size", None)
+        # baseline from the CURRENT cache: wrapping an already-warm jitted
+        # function must not read its pre-existing entries as fresh recompiles
+        baseline = 0
+        if callable(cache_size):
+            try:
+                baseline = cache_size()
+            except Exception:
+                cache_size = None
+        state = {"last_cache": baseline, "baseline": baseline}
+
+        def wrapped(*args, **kwargs):
+            sig = (
+                key_fn(*args, **kwargs)
+                if key_fn is not None
+                else abstract_signature((args, kwargs))
+            )
+            self.note(sig)
+            out = fn(*args, **kwargs)
+            if callable(cache_size):
+                try:
+                    now = cache_size()
+                except Exception:
+                    return out
+                if now > state["last_cache"]:
+                    grew = now - state["last_cache"]
+                    state["last_cache"] = now
+                    # every growth SINCE WRAP must be explained by a new
+                    # signature; an unexplained one is an untracked recompile
+                    with self._lock:
+                        explained = len(self._seen)
+                    if now - state["baseline"] > explained:
+                        self.note(("untracked-recompile", now, grew))
+            return out
+
+        wrapped.guard = self  # type: ignore[attr-defined]
+        return wrapped
+
+    def __enter__(self) -> "RecompileGuard":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None:
+            self.check()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# declared program families for this framework
+# ---------------------------------------------------------------------------
+
+
+def batch_buckets(max_batch: int) -> Tuple[int, ...]:
+    """The task-batch sizes the serving engine pads to — derived from the
+    engine's own ``_batch_bucket`` (the single source of truth), so a change
+    to its rounding policy can never drift the planned set out from under
+    the guard."""
+    from ..serving.engine import _batch_bucket  # local: avoid import cycle
+
+    return tuple(sorted({_batch_bucket(n, max_batch) for n in range(1, max_batch + 1)}))
+
+
+def serving_planned_programs(serving_cfg) -> Set[Tuple[str, int, int]]:
+    """Every (kind, shape-bucket, batch-bucket) program the engine's bucket
+    tables plan for. A request larger than the largest bucket compiles its
+    exact shape on demand — correct, but *unplanned*: strict mode exists to
+    make exactly that loud."""
+    batches = batch_buckets(serving_cfg.max_batch_size)
+    planned: Set[Tuple[str, int, int]] = set()
+    for bucket in serving_cfg.support_buckets:
+        planned.update(("adapt", bucket, b) for b in batches)
+    for bucket in serving_cfg.query_buckets:
+        planned.update(("predict", bucket, b) for b in batches)
+    return planned
+
+
+def train_planned_programs(cfg) -> Set[Tuple[str, ...]]:
+    """The runner-side program family: train step (single and multi-dispatch)
+    keyed by the (second_order, msl_active) static switches the config can
+    actually reach, plus the eval programs."""
+    # Over-planning is free (the planned set only REJECTS unplanned keys);
+    # under-planning kills a healthy run. So: when a switch is off, only its
+    # False variant is planned; when it is on, BOTH variants are — whatever
+    # corner the annealing-window arithmetic (msl_active: epoch <
+    # multi_step_loss_num_epochs; use_second_order: epoch >
+    # first_order_to_second_order_epoch) lands in at runtime is covered.
+    so_values = {False} if not cfg.second_order else {True, False}
+    msl_values = (
+        {False} if not cfg.use_multi_step_loss_optimization else {True, False}
+    )
+    planned: Set[Tuple[str, ...]] = {("eval",), ("eval_multi",)}
+    for so in so_values:
+        for msl in msl_values:
+            planned.add(("train", so, msl))
+            planned.add(("train_multi", so, msl))
+    return planned

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""graftlint CLI — lint the repo's program families for JAX/TPU hazards.
+
+Usage:
+    python scripts/lint.py [--json] [--rule GLxxx ...] [--list-rules] PATH...
+
+    python scripts/lint.py howtotrainyourmamlpytorch_tpu scripts
+
+Exit codes: 0 = clean, 1 = findings, 2 = usage error. ``--json`` emits the
+machine-readable payload (schema asserted by tests/test_graftlint.py);
+``scripts/sweep.sh`` runs it as a preflight so a hazard aborts before any
+TPU time is burned. Rule catalog: docs/STATIC_ANALYSIS.md.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from tools.graftlint import (  # noqa: E402
+    RULES,
+    report_human,
+    report_json,
+    run_lint,
+)
+from tools.graftlint.engine import _ensure_rules_loaded  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("paths", nargs="*", help="files or directories to lint")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument(
+        "--rule",
+        action="append",
+        default=[],
+        metavar="GLxxx",
+        help="run only these rule ids (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as exc:
+        # --help exits 0 and must stay 0; real usage errors normalize to 2
+        code = exc.code if isinstance(exc.code, int) else 2
+        return 0 if code == 0 else 2
+    _ensure_rules_loaded()
+    if args.list_rules:
+        for rule_id in sorted(RULES):
+            print(f"{rule_id}  {RULES[rule_id].title}")
+        return 0
+    if not args.paths:
+        print("lint.py: at least one path is required", file=sys.stderr)
+        return 2
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"lint.py: no such path: {path}", file=sys.stderr)
+            return 2
+    for rule_id in args.rule:
+        if rule_id.upper() not in RULES:
+            print(
+                f"lint.py: unknown rule {rule_id!r} (have {', '.join(sorted(RULES))})",
+                file=sys.stderr,
+            )
+            return 2
+    active, suppressed = run_lint(args.paths, args.rule or None)
+    if args.json:
+        print(report_json(active, suppressed))
+    else:
+        print(report_human(active, suppressed))
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,18 @@
 # mtime is the liveness signal, so stdout must not sit in a block buffer.
 set -u
 cd /root/repo
+# graftlint preflight: a jax-hazard / concurrency / contract finding aborts
+# the sweep BEFORE any TPU time is burned (an un-noticed recompile or host
+# sync silently eats the whole chip budget; a typo'd fault seam makes a
+# drill a no-op). rc=1 findings / rc=2 usage both abort; the JSON payload
+# lands next to the sweep log for the post-mortem.
+mkdir -p exps
+if ! python scripts/lint.py --json howtotrainyourmamlpytorch_tpu scripts \
+    > exps/graftlint_preflight.json 2>> exps/sweep_r3.log; then
+  echo "=== $(date -u +%H:%M:%S) graftlint preflight FAILED (see exps/graftlint_preflight.json) — aborting sweep" >> exps/sweep_r3.log
+  echo "graftlint preflight failed; sweep aborted before touching the TPU" >&2
+  exit 1
+fi
 COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
  dataset.path=/root/reference/datasets/omniglot_dataset \
  index_cache_dir=/tmp/omniglot_idx load_into_memory=true \

@@ -31,10 +31,41 @@ var — the drill seam that lets tests (and chaos soaks) simulate a hung or
 erroring tunnel without real hardware.
 """
 import argparse
+import importlib.util
 import os
 import subprocess
 import sys
 import time
+
+
+def _load_exit_codes():
+    """The central rc registry, loaded by FILE PATH: importing it as a package
+    submodule would pull the whole (jax-heavy) package into this process, and
+    this gate must stay import-light — it runs precisely when the backend may
+    be down. ``bench.py`` reuses this loader via ``from wait_for_tpu import
+    exit_codes``. A standalone copy of this script (artifact snapshots carry
+    scripts/ without the package) falls back to the historical literals —
+    the gate must keep probing, and bench's one-JSON-line contract must not
+    gain an import failure mode."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "howtotrainyourmamlpytorch_tpu",
+        "exit_codes.py",
+    )
+    try:
+        spec = importlib.util.spec_from_file_location("htymp_exit_codes", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        import types
+
+        return types.SimpleNamespace(
+            OK=0, USAGE=2, TPU_WAIT_DEADLINE=64, TPU_WAIT_WEDGED=65
+        )
+
+
+exit_codes = _load_exit_codes()
 
 # The probe rejects the CPU fallback: when the tunneled plugin errors fast
 # (instead of hanging) jax falls back to the host CPU backend, which must not
@@ -46,10 +77,10 @@ _PROBE_TPU = (
 )
 _PROBE_ANY = "import jax; d = jax.devices(); print('BACKEND_OK', len(d), d[0].device_kind)"
 
-#: exit codes (documented in docs/OPERATIONS.md rc table)
-RC_UP = 0
-RC_DEADLINE = 64
-RC_WEDGED = 65
+#: exit codes (single source of truth: exit_codes.py; docs/OPERATIONS.md table)
+RC_UP = exit_codes.OK
+RC_DEADLINE = exit_codes.TPU_WAIT_DEADLINE
+RC_WEDGED = exit_codes.TPU_WAIT_WEDGED
 
 
 def wait_for_backend(

@@ -1,0 +1,276 @@
+"""Strict-mode RecompileGuard: lowering counts vs declared program-family
+budgets. The serving engine must pass under repeated MIXED-shape traffic
+(bucketing is the whole point: novel request shapes reuse compiled
+programs), and a deliberately shape-unstable function must trip."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from howtotrainyourmamlpytorch_tpu.config import Config, ServingConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+from howtotrainyourmamlpytorch_tpu.serving.engine import AdaptationEngine
+from howtotrainyourmamlpytorch_tpu.utils.strictmode import (
+    RecompileBudgetExceededError,
+    RecompileGuard,
+    abstract_signature,
+    batch_buckets,
+    serving_planned_programs,
+    train_planned_programs,
+)
+
+IMG = (28, 28, 1)
+
+
+def _tiny_cfg(**overrides):
+    base = dict(
+        num_classes_per_set=5,
+        num_samples_per_class=1,
+        num_target_samples=2,
+        batch_size=2,
+        number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1,
+        strict_recompile_guard=True,
+        serving=ServingConfig(
+            support_buckets=[8], query_buckets=[16], max_batch_size=2
+        ),
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def _tiny_system(cfg):
+    return MAMLSystem(
+        cfg,
+        model=build_vgg(IMG, cfg.num_classes_per_set, num_stages=1, cnn_num_filters=2),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the guard itself
+# ---------------------------------------------------------------------------
+
+
+def test_wrap_counts_lowerings_not_calls():
+    guard = RecompileGuard(budget=2, name="t")
+    fn = guard.wrap(jax.jit(lambda x: x * 2))
+    for _ in range(4):
+        fn(np.zeros(3, np.float32))
+    assert guard.lowerings == 1  # four calls, one program
+    fn(np.zeros(5, np.float32))
+    assert guard.lowerings == 2
+
+
+def test_wrap_trips_on_shape_unstable_function():
+    """The hazard class: a function whose every call sees a fresh shape
+    compiles per call — the guard must make that loud at budget + 1."""
+    guard = RecompileGuard(budget=3, name="unstable")
+    fn = guard.wrap(jax.jit(jnp.sum))
+    for n in range(1, 4):
+        fn(np.zeros(n, np.float32))  # three shapes: at budget
+    with pytest.raises(RecompileBudgetExceededError) as exc:
+        fn(np.zeros(9, np.float32))
+    assert "budget of 3" in str(exc.value)
+
+
+def test_planned_set_rejects_unplanned_key_immediately():
+    guard = RecompileGuard(planned={("a", 1), ("a", 2)}, name="fam")
+    guard.note(("a", 1))
+    guard.note(("a", 1))  # idempotent
+    assert guard.lowerings == 1
+    with pytest.raises(RecompileBudgetExceededError) as exc:
+        guard.note(("b", 7))
+    assert "unplanned program" in str(exc.value)
+
+
+def test_non_strict_collects_and_check_raises():
+    guard = RecompileGuard(budget=1, name="soft", strict=False)
+    guard.note("p1")
+    guard.note("p2")  # over budget, but observe-only
+    assert len(guard.violations) == 1
+    with pytest.raises(RecompileBudgetExceededError):
+        guard.check()
+    # context-manager exit runs check() too
+    with pytest.raises(RecompileBudgetExceededError):
+        with RecompileGuard(budget=1, strict=False) as g:
+            g.note("x")
+            g.note("y")
+
+
+def test_reset_forgets_seen_programs():
+    guard = RecompileGuard(budget=1, name="r")
+    guard.note("p1")
+    guard.reset()
+    guard.note("p2")  # would have tripped without the reset
+    assert guard.lowerings == 1
+
+
+def test_abstract_signature_distinguishes_shape_dtype_and_statics():
+    a = abstract_signature({"x": np.zeros((2, 3), np.float32), "k": 5})
+    same = abstract_signature({"x": np.ones((2, 3), np.float32), "k": 5})
+    other_shape = abstract_signature({"x": np.zeros((2, 4), np.float32), "k": 5})
+    other_dtype = abstract_signature({"x": np.zeros((2, 3), np.int32), "k": 5})
+    other_static = abstract_signature({"x": np.zeros((2, 3), np.float32), "k": 6})
+    assert a == same
+    assert len({a, other_shape, other_dtype, other_static}) == 4
+
+
+def test_batch_buckets_shapes():
+    assert batch_buckets(8) == (1, 2, 4, 8)
+    assert batch_buckets(6) == (1, 2, 4, 6)
+    assert batch_buckets(1) == (1,)
+
+
+# ---------------------------------------------------------------------------
+# serving engine under strict mode
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def strict_engine():
+    cfg = _tiny_cfg()
+    system = _tiny_system(cfg)
+    return AdaptationEngine(system, system.init_train_state())
+
+
+def _support(n_shots, seed):
+    epi = synthetic_batch(1, 5, n_shots, 2, IMG, seed=seed)
+    return epi["x_support"][0], epi["y_support"][0]
+
+
+def test_engine_guard_enabled_via_config(strict_engine):
+    assert strict_engine.recompile_guard is not None
+    planned = serving_planned_programs(strict_engine.serving)
+    assert ("adapt", 8, 1) in planned and ("predict", 16, 2) in planned
+
+
+def test_engine_passes_repeated_mixed_shape_traffic(strict_engine):
+    """Support sizes 5 and 8 pad to one bucket; single and paired requests
+    pad to the batch buckets — the whole mixed-traffic stream stays inside
+    the planned family, across repeats."""
+    for seed in range(3):
+        fw = strict_engine.adapt(*_support(1, seed))       # support 5 -> bucket 8
+        strict_engine.adapt_batch(
+            [_support(1, 10 + seed), _support(1, 20 + seed)]
+        )
+        q = synthetic_batch(1, 5, 1, 2, IMG, seed=seed)["x_target"][0]
+        strict_engine.predict(fw, q.reshape(-1, *IMG))     # query 10 -> bucket 16
+    snap = strict_engine.recompile_guard.snapshot()
+    assert snap["violations"] == []
+    counts = strict_engine.compile_counts()
+    assert counts["adapt_programs"] <= len(
+        serving_planned_programs(strict_engine.serving)
+    )
+    assert counts["recompile_guard"]["lowerings"] >= 2
+
+
+def test_engine_trips_on_oversize_request_even_on_retry(strict_engine):
+    """A rejected key is never recorded as seen, so a client retrying the
+    identical oversize request keeps getting refused instead of slipping
+    past the guard into the XLA compile on attempt two (review fix)."""
+    x, y = _support(4, 99)  # support 20 > largest bucket 8: unplanned program
+    for _ in range(2):
+        with pytest.raises(RecompileBudgetExceededError) as exc:
+            strict_engine.adapt(x, y)
+        assert "unplanned program" in str(exc.value)
+    assert strict_engine.compile_counts()["adapt_programs"] <= len(
+        serving_planned_programs(strict_engine.serving)
+    )
+
+
+def test_engine_default_is_permissive():
+    cfg = _tiny_cfg(strict_recompile_guard=False)
+    system = _tiny_system(cfg)
+    engine = AdaptationEngine(system, system.init_train_state())
+    assert engine.recompile_guard is None
+    x, y = _support(4, 7)  # oversize compiles on demand, as documented
+    engine.adapt(x, y)
+    assert engine.compile_counts()["adapt_programs"] == 1
+
+
+# ---------------------------------------------------------------------------
+# runner-side train family under strict mode
+# ---------------------------------------------------------------------------
+
+
+def test_train_family_within_plan_across_msl_boundary():
+    cfg = _tiny_cfg(
+        total_epochs=4, multi_step_loss_num_epochs=2, second_order=True
+    )
+    system = _tiny_system(cfg)
+    planned = train_planned_programs(cfg)
+    assert ("train", True, True) in planned and ("train", True, False) in planned
+    state = system.init_train_state()
+    batch = {
+        k: np.asarray(v)
+        for k, v in synthetic_batch(2, 5, 1, 2, IMG, seed=0).items()
+    }
+    for epoch in (0, 1, 2, 3):  # crosses the MSL-annealing boundary
+        state, _ = system.train_step(state, batch, epoch=epoch)
+    snap = system.recompile_guard.snapshot()
+    assert snap["violations"] == []
+    assert len(system._train_step_cache) == 2  # the two planned variants
+
+
+def test_wrap_on_prewarmed_function_sees_no_false_recompile():
+    """Wrapping an already-warm jitted function must not read pre-existing
+    cache entries as fresh lowerings (review fix: baseline at wrap time)."""
+    jitted = jax.jit(lambda x: x + 1)
+    jitted(np.zeros(2, np.float32))
+    jitted(np.zeros(3, np.float32))  # two warm programs before wrapping
+    guard = RecompileGuard(budget=1, name="warm")
+    fn = guard.wrap(jitted)
+    fn(np.zeros(2, np.float32))  # cache hit: one signature, zero compiles
+    assert guard.lowerings == 1
+    assert guard.violations == []
+
+
+def test_wrap_counts_static_kwarg_value_changes():
+    """A changed static kwarg is a real recompile driver and must count
+    (review fix: kwarg VALUES enter the signature, not just names)."""
+    guard = RecompileGuard(budget=2, name="kw")
+    fn = guard.wrap(lambda x, mode=0: x)  # no _cache_size: signatures only
+    fn(np.zeros(2, np.float32), mode=1)
+    fn(np.zeros(2, np.float32), mode=1)
+    assert guard.lowerings == 1
+    fn(np.zeros(2, np.float32), mode=2)
+    assert guard.lowerings == 2
+
+
+def test_train_plan_covers_msl_window_corner():
+    """use_multi_step_loss_optimization=True with a zero-length annealing
+    window means msl_active is always False at runtime; the planned family
+    must still cover it (review fix: over-plan, never under-plan)."""
+    cfg = _tiny_cfg(
+        total_epochs=2,
+        use_multi_step_loss_optimization=True,
+        multi_step_loss_num_epochs=0,
+    )
+    planned = train_planned_programs(cfg)
+    assert ("train", True, False) in planned
+    system = _tiny_system(cfg)
+    state = system.init_train_state()
+    batch = {
+        k: np.asarray(v)
+        for k, v in synthetic_batch(2, 5, 1, 2, IMG, seed=0).items()
+    }
+    state, _ = system.train_step(state, batch, epoch=0)  # must not trip
+    assert system.recompile_guard.snapshot()["violations"] == []
+
+
+def test_scale_meta_lr_reset_replans_the_family():
+    cfg = _tiny_cfg(total_epochs=2)
+    system = _tiny_system(cfg)
+    state = system.init_train_state()
+    batch = {
+        k: np.asarray(v)
+        for k, v in synthetic_batch(2, 5, 1, 2, IMG, seed=0).items()
+    }
+    state, _ = system.train_step(state, batch, epoch=0)
+    system.scale_meta_lr(0.5)  # drops compiled programs on purpose
+    state, _ = system.train_step(state, batch, epoch=0)  # recompile: no trip
+    assert system.recompile_guard.snapshot()["violations"] == []

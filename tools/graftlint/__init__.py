@@ -1,0 +1,30 @@
+"""graftlint — a JAX/TPU hazard linter for this repo's program families.
+
+Rule families (catalog + rationale: docs/STATIC_ANALYSIS.md):
+
+- **GL1xx jax hazards** — tracer concretization / Python control flow in
+  jit-reachable code, host syncs on designated hot paths, nondeterminism
+  sources, donation-after-use.
+- **GL2xx concurrency** — unguarded read-modify-writes in threaded classes,
+  untimed blocking waits.
+- **GL3xx contracts** — exit-code registry discipline, OPERATIONS.md rc
+  table drift, fault-seam name registry.
+
+Entry points: ``scripts/lint.py`` (CLI; rc=0 clean / 1 findings / 2 usage)
+and the library API here. Stdlib-``ast`` only — no dependencies, so the
+tier-1 self-gate (tests/test_graftlint.py) runs anywhere the suite runs.
+"""
+
+from .engine import (  # noqa: F401
+    RULES,
+    Finding,
+    Module,
+    Project,
+    Rule,
+    load_project,
+    register,
+    report_human,
+    report_json,
+    run_lint,
+)
+from . import rules_concurrency, rules_contracts, rules_jax  # noqa: F401  (register)

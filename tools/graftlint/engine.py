@@ -1,0 +1,317 @@
+"""graftlint engine: file walking, suppressions, the rule registry, output.
+
+The linter is deliberately stdlib-``ast`` only (no new dependencies — the
+tier-1 self-gate must run anywhere the test suite runs). Rules register
+themselves into :data:`RULES` via the :func:`register` decorator and get two
+hooks:
+
+- ``check_module(module, project)`` — per-file findings (most rules)
+- ``check_project(project)`` — cross-file contracts (rc table vs registry,
+  fault-seam names vs their single source of truth)
+
+Suppression contract (docs/STATIC_ANALYSIS.md): a finding is suppressed by
+``# graftlint: disable=GL110`` (comma-separate several ids, or ``all``) on
+the finding's own line, or on an immediately preceding comment-only line —
+so every suppression can carry its one-line justification::
+
+    # deliberate one-dispatch-lag loss check  # graftlint: disable=GL110
+    loss_host = np.asarray(jax.device_get(loss_dev))
+
+Suppressions silence a finding but it is still counted (``suppressed`` in
+the JSON payload), so "how much is being waved through" stays observable.
+"""
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9*,\s]+?)\s*(?:#|$)")
+MARKER_RE = re.compile(r"#\s*graftlint:\s*(hot-path|threaded|holds-lock)\b")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative where possible
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "suppressed": self.suppressed,
+        }
+
+
+class Module:
+    """One parsed python file + its comment-derived metadata."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of suppressed rule ids ('*' = all)
+        self.suppressions: Dict[int, Set[str]] = {}
+        # line -> set of markers ('hot-path' | 'threaded' | 'holds-lock')
+        self.markers: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(text)
+            if m:
+                ids = {
+                    s.strip().upper().replace("ALL", "*")
+                    for s in m.group(1).split(",")
+                    if s.strip()
+                }
+                self.suppressions.setdefault(i, set()).update(ids)
+            m = MARKER_RE.search(text)
+            if m:
+                self.markers.setdefault(i, set()).add(m.group(1))
+        # import alias -> dotted module ("jnp" -> "jax.numpy"); plus
+        # from-imports of plain names ("Lock" -> "threading.Lock")
+        self.import_aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_aliases[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for alias in node.names:
+                    self.import_aliases[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+
+    # ------------------------------------------------------------------
+
+    def _is_comment_only(self, line: int) -> bool:
+        if not 1 <= line <= len(self.lines):
+            return False
+        text = self.lines[line - 1].strip()
+        return text.startswith("#")
+
+    def _marks_at(self, table: Dict[int, Set[str]], line: int) -> Set[str]:
+        """Marks on ``line`` plus any carried by the run of comment-only
+        lines immediately above it (where justifications live)."""
+        out = set(table.get(line, ()))
+        above = line - 1
+        while self._is_comment_only(above):
+            out |= table.get(above, set())
+            above -= 1
+        return out
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        ids = self._marks_at(self.suppressions, line)
+        return "*" in ids or rule_id.upper() in ids
+
+    def has_marker(self, marker: str, line: int) -> bool:
+        return marker in self._marks_at(self.markers, line)
+
+    def resolve_root(self, name: str) -> str:
+        """Dotted module an identifier refers to, or the identifier itself."""
+        return self.import_aliases.get(name, name)
+
+
+class Project:
+    def __init__(self, roots: List[str], modules: List[Module], errors: List[Finding]):
+        self.roots = roots
+        self.modules = modules
+        self.parse_errors = errors
+        self.repo_root = self._find_repo_root()
+
+    def _find_repo_root(self) -> str:
+        probe = os.path.abspath(self.roots[0]) if self.roots else os.getcwd()
+        if os.path.isfile(probe):
+            probe = os.path.dirname(probe)
+        for _ in range(6):
+            if os.path.isdir(os.path.join(probe, "docs")) or os.path.isdir(
+                os.path.join(probe, ".git")
+            ):
+                return probe
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                break
+            probe = parent
+        return os.getcwd()
+
+    def module_by_suffix(self, suffix: str) -> Optional[Module]:
+        suffix = suffix.replace(os.sep, "/")
+        for mod in self.modules:
+            if mod.rel.endswith(suffix):
+                return mod
+        return None
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+RULES: Dict[str, "Rule"] = {}
+
+
+class Rule:
+    id: str = ""
+    title: str = ""
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+def register(cls):
+    inst = cls()
+    if not inst.id or inst.id in RULES:
+        raise ValueError(f"rule id missing or duplicate: {inst.id!r}")
+    RULES[inst.id] = inst
+    return cls
+
+
+def _ensure_rules_loaded() -> None:
+    # rule modules register on import; local imports avoid a cycle
+    from . import rules_concurrency, rules_contracts, rules_jax  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# running
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".claude"}
+
+
+def _iter_py_files(path: str) -> Iterable[str]:
+    if os.path.isfile(path):
+        if path.endswith(".py"):
+            yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in _SKIP_DIRS and not d.startswith(".")
+        )
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def load_project(paths: List[str]) -> Project:
+    base = os.getcwd()
+    modules: List[Module] = []
+    errors: List[Finding] = []
+    for root in paths:
+        for file_path in _iter_py_files(root):
+            rel = os.path.relpath(file_path, base)
+            if rel.startswith(".."):
+                rel = file_path
+            try:
+                with open(file_path, encoding="utf-8") as f:
+                    source = f.read()
+                modules.append(Module(file_path, rel, source))
+            except (SyntaxError, UnicodeDecodeError) as exc:
+                line = getattr(exc, "lineno", 1) or 1
+                errors.append(
+                    Finding("GL001", rel, line, 0, f"file does not parse: {exc}")
+                )
+    return Project([os.path.abspath(p) for p in paths], modules, errors)
+
+
+def run_lint(
+    paths: List[str], rule_ids: Optional[List[str]] = None
+) -> Tuple[List[Finding], List[Finding]]:
+    """Lint ``paths``; returns ``(active_findings, suppressed_findings)``.
+
+    ``rule_ids`` restricts the run to a subset (the CLI's ``--rule``)."""
+    _ensure_rules_loaded()
+    project = load_project(paths)
+    selected = (
+        [RULES[r.upper()] for r in rule_ids] if rule_ids else list(RULES.values())
+    )
+    findings: List[Finding] = list(project.parse_errors)
+    for rule in selected:
+        for mod in project.modules:
+            findings.extend(rule.check_module(mod, project))
+        findings.extend(rule.check_project(project))
+    if rule_ids:
+        # a shared analysis may emit sibling-rule findings (GL101/GL102 run
+        # one fixpoint); honor the selection at the output boundary too
+        wanted = {r.upper() for r in rule_ids}
+        findings = [f for f in findings if f.rule in wanted or f.rule == "GL001"]
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    by_path = {m.rel: m for m in project.modules}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.col)):
+        mod = by_path.get(f.path)
+        if mod is not None and mod.is_suppressed(f.rule, f.line):
+            f.suppressed = True
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+def report_json(active: List[Finding], suppressed: List[Finding]) -> str:
+    counts: Dict[str, int] = {}
+    for f in active:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return json.dumps(
+        {
+            "tool": "graftlint",
+            "version": 1,
+            "findings": [f.to_dict() for f in active],
+            "counts": counts,
+            "suppressed": [f.to_dict() for f in suppressed],
+        },
+        indent=2,
+    )
+
+
+def report_human(active: List[Finding], suppressed: List[Finding]) -> str:
+    _ensure_rules_loaded()
+    lines = [f.format() for f in active]
+    lines.append(
+        f"graftlint: {len(active)} finding(s), "
+        f"{len(suppressed)} suppressed"
+    )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# small shared AST helpers (used by the rule modules)
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.experimental.pjit.pjit' for nested Attributes, 'name' for Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) and not isinstance(
+        node.value, bool
+    ):
+        return node.value
+    return None

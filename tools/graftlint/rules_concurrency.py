@@ -1,0 +1,229 @@
+"""GL2xx — concurrency rules.
+
+GL201  read-modify-write of shared state outside a lock in a threaded class
+GL202  untimed blocking waits (``Future.result()`` / ``Queue.get()``)
+
+A class is "threaded" when the linter can see concurrency in it: it starts a
+``threading.Thread``/``Timer``, owns a ``ThreadPoolExecutor``, owns a lock
+(``Lock``/``RLock``/``Condition``/``Semaphore`` assigned to ``self.*`` — the
+author already declared the instance concurrent), or carries an explicit
+``# graftlint: threaded`` marker on its ``class`` line.
+
+GL201 deliberately flags only read-modify-write shapes — ``self.x += 1`` and
+``self.d[k] = v`` — not plain rebinds (``self.x = v``), which are single
+GIL-atomic stores. Lost-update counters were exactly the PR2 review bug class
+(``FaultInjector`` call counters raced by loader-pool / batcher / HTTP
+threads). Methods named ``*_locked`` (or marked ``# graftlint: holds-lock``)
+are assumed to run under their caller's lock.
+
+GL202 flags ``.result()`` with no timeout anywhere, and ``.get()`` with no
+timeout on receivers the module visibly binds to ``queue.Queue``-family
+constructors. A hung device call parks an untimed waiter forever — the
+BENCH_r03–r05 wedge signature; every documented exception needs a
+justification naming its supervisor.
+"""
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .engine import Finding, Module, Project, Rule, call_name, register
+
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+THREAD_CTORS = {"Thread", "Timer"}
+EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "JoinableQueue"}
+#: attribute names accepted as lock-like in a `with self.<attr>:` guard even
+#: when their construction wasn't seen (subclasses, injected locks)
+LOCKY_FRAGMENTS = ("lock", "cond", "wake", "mutex", "sem")
+
+
+def _ctor_last(call: ast.Call) -> str:
+    name = call_name(call) or ""
+    return name.split(".")[-1]
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, module: Module, cls: ast.ClassDef):
+        self.cls = cls
+        self.lock_attrs: Set[str] = set()
+        self.threaded = module.has_marker("threaded", cls.lineno)
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call):
+                last = _ctor_last(node)
+                if last in THREAD_CTORS or last in EXECUTOR_CTORS:
+                    self.threaded = True
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                last = _ctor_last(node.value)
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr and last in LOCK_CTORS:
+                        self.lock_attrs.add(attr)
+                        self.threaded = True
+
+    def is_lock_guard(self, expr: ast.AST) -> bool:
+        attr = _self_attr(expr)
+        if attr is None:
+            return False
+        return attr in self.lock_attrs or any(
+            frag in attr.lower() for frag in LOCKY_FRAGMENTS
+        )
+
+
+@register
+class UnguardedSharedWrite(Rule):
+    id = "GL201"
+    title = "shared-state read-modify-write outside a lock"
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for cls in [
+            n for n in ast.walk(module.tree) if isinstance(n, ast.ClassDef)
+        ]:
+            info = _ClassInfo(module, cls)
+            if not info.threaded:
+                continue
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if (
+                    method.name in ("__init__", "__new__", "__del__")
+                    or method.name.endswith("_locked")
+                    or module.has_marker("holds-lock", method.lineno)
+                ):
+                    continue
+                findings.extend(self._walk(module, cls.name, info, method.body, False))
+        return findings
+
+    def _walk(
+        self,
+        module: Module,
+        cls_name: str,
+        info: _ClassInfo,
+        stmts: List[ast.stmt],
+        guarded: bool,
+    ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                now_guarded = guarded or any(
+                    info.is_lock_guard(item.context_expr) for item in stmt.items
+                )
+                out.extend(self._walk(module, cls_name, info, stmt.body, now_guarded))
+                continue
+            if not guarded:
+                out.extend(self._check_stmt(module, cls_name, stmt))
+            # nested blocks inherit the current guard state
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub and not isinstance(stmt, ast.With):
+                    out.extend(self._walk(module, cls_name, info, sub, guarded))
+            for handler in getattr(stmt, "handlers", []) or []:
+                out.extend(self._walk(module, cls_name, info, handler.body, guarded))
+        return out
+
+    def _check_stmt(self, module, cls_name, stmt) -> Iterable[Finding]:
+        shapes = []
+        if isinstance(stmt, ast.AugAssign):
+            attr = _self_attr(stmt.target)
+            if attr:
+                shapes.append((stmt, attr, f"self.{attr} {type(stmt.op).__name__}="))
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else (
+            [stmt.target] if isinstance(stmt, ast.AugAssign) else []
+        )
+        for target in targets:
+            if isinstance(target, ast.Subscript):
+                attr = _self_attr(target.value)
+                if attr:
+                    shapes.append((stmt, attr, f"self.{attr}[...] ="))
+        out = []
+        for node, attr, shape in shapes:
+            out.append(
+                Finding(
+                    self.id,
+                    module.rel,
+                    node.lineno,
+                    node.col_offset,
+                    f"`{shape}` in threaded class {cls_name} outside a "
+                    "`with <lock>:` block — a read-modify-write racing "
+                    "another thread loses updates; guard it (or mark the "
+                    "method `*_locked` if the caller holds the lock)",
+                )
+            )
+        return out
+
+
+@register
+class UntimedBlockingWait(Rule):
+    id = "GL202"
+    title = "untimed blocking wait"
+
+    def _queue_names(self, module: Module) -> Set[str]:
+        """Names (locals and self attrs, flattened) visibly bound to Queue
+        constructors anywhere in the module."""
+        names: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _ctor_last(node.value) in QUEUE_CTORS:
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            names.add(target.id)
+                        else:
+                            attr = _self_attr(target)
+                            if attr:
+                                names.add(attr)
+        return names
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        queue_names = self._queue_names(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            has_timeout = bool(node.args) or any(
+                kw.arg in ("timeout", "block") for kw in node.keywords
+            )
+            if node.func.attr == "result" and not has_timeout:
+                findings.append(
+                    Finding(
+                        self.id,
+                        module.rel,
+                        node.lineno,
+                        node.col_offset,
+                        ".result() with no timeout waits forever on a hung "
+                        "device call (the wedge signature); pass timeout= "
+                        "or document the supervising watchdog in a "
+                        "suppression",
+                    )
+                )
+            elif node.func.attr == "get" and not has_timeout and not node.keywords:
+                recv = node.func.value
+                recv_name = (
+                    recv.id
+                    if isinstance(recv, ast.Name)
+                    else _self_attr(recv) or ""
+                )
+                if recv_name in queue_names:
+                    findings.append(
+                        Finding(
+                            self.id,
+                            module.rel,
+                            node.lineno,
+                            node.col_offset,
+                            f"`{recv_name}.get()` with no timeout blocks "
+                            "forever if the producer died; pass timeout= "
+                            "and handle Empty",
+                        )
+                    )
+        return findings

@@ -1,0 +1,343 @@
+"""GL3xx — contract rules: cross-checks against single sources of truth.
+
+GL301  bare exit-code literal at an exit site (the registry is
+       ``howtotrainyourmamlpytorch_tpu/exit_codes.py``)
+GL302  docs/OPERATIONS.md rc table drifted from the registry
+GL303  fault-seam name not in ``resilience/faults.py::SEAMS``
+
+All three read their source of truth STATICALLY (ast / text) — the linter
+never imports the code it lints, so it runs on broken trees and costs no
+jax import.
+"""
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import Finding, Module, Project, Rule, call_name, const_int, register
+
+EXIT_CODES_SUFFIX = "exit_codes.py"
+FAULTS_SUFFIX = "resilience/faults.py"
+
+#: codes whose bare use is fine everywhere (generic CLI conventions / HTTP
+#: statuses used in wire-level assertions)
+_GENERIC_CODES = {0, 1, 2, 503, 504}
+
+
+def _module_int_consts(mod: Module) -> Dict[str, int]:
+    """Module-level ``NAME = <int>`` assignments."""
+    out: Dict[str, int] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = const_int(node.value)
+            if isinstance(target, ast.Name) and value is not None:
+                out[target.id] = value
+    return out
+
+
+def _registry_codes(project: Project) -> Optional[Set[int]]:
+    """The special process exit codes from the registry module, or None when
+    the lint roots don't include one (rule inactive)."""
+    mod = project.module_by_suffix(EXIT_CODES_SUFFIX)
+    if mod is None:
+        return None
+    consts = _module_int_consts(mod)
+    return {v for v in consts.values() if v not in _GENERIC_CODES}
+
+
+@register
+class BareExitCodeLiteral(Rule):
+    id = "GL301"
+    title = "bare exit-code literal instead of the exit_codes registry"
+
+    _EXIT_CALLS = {"SystemExit", "exit", "sys.exit", "os._exit", "_exit"}
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        if module.rel.endswith(EXIT_CODES_SUFFIX):
+            return []
+        special = _registry_codes(project)
+        if not special:
+            return []
+        findings: List[Finding] = []
+
+        def flag(node: ast.AST, code: int, where: str) -> None:
+            findings.append(
+                Finding(
+                    self.id,
+                    module.rel,
+                    node.lineno,
+                    node.col_offset,
+                    f"bare exit code {code} {where} — import it from the "
+                    "exit_codes registry so the contract can't drift",
+                )
+            )
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                if name in self._EXIT_CALLS or name.split(".")[-1] in (
+                    "exit",
+                    "_exit",
+                    "SystemExit",
+                ):
+                    for arg in node.args:
+                        code = const_int(arg)
+                        if code in special:
+                            flag(arg, code, f"passed to {name}()")
+                for kw in node.keywords:
+                    if kw.arg and kw.arg.endswith("exit_code"):
+                        code = const_int(kw.value)
+                        if code in special:
+                            flag(kw.value, code, f"as {kw.arg}=")
+            elif isinstance(node, ast.Compare):
+                for comp in node.comparators:
+                    if isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                        lits = [const_int(e) for e in comp.elts]
+                        hits = [c for c in lits if c in special]
+                        if hits and any(
+                            isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+                        ):
+                            flag(
+                                comp,
+                                hits[0],
+                                "in a membership test against literal codes",
+                            )
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                if node.target.id.endswith("exit_code") and node.value is not None:
+                    code = const_int(node.value)
+                    if code in special:
+                        flag(node.value, code, f"as default of {node.target.id}")
+        return findings
+
+
+@register
+class OperationsRcTableDrift(Rule):
+    id = "GL302"
+    title = "docs/OPERATIONS.md rc table drifted from the registry"
+
+    _ROW_RE = re.compile(r"^\|\s*(\d+)\s*\|")
+
+    def _registry_table(self, mod: Module) -> Optional[Dict[int, str]]:
+        """Statically evaluate ``TRAIN_PROCESS_RCS = {NAME: "...", ...}``."""
+        consts = _module_int_consts(mod)
+        for node in mod.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "TRAIN_PROCESS_RCS"
+                and isinstance(node.value, ast.Dict)
+            ):
+                table: Dict[int, str] = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    code = (
+                        consts.get(k.id) if isinstance(k, ast.Name) else const_int(k)
+                    )
+                    if code is None:
+                        return None
+                    table[code] = (
+                        v.value if isinstance(v, ast.Constant) else ""
+                    )
+                return table
+        return None
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        reg_mod = project.module_by_suffix(EXIT_CODES_SUFFIX)
+        if reg_mod is None:
+            return []
+        table = self._registry_table(reg_mod)
+        if table is None:
+            return [
+                Finding(
+                    self.id,
+                    reg_mod.rel,
+                    1,
+                    0,
+                    "exit_codes.py has no statically-readable "
+                    "TRAIN_PROCESS_RCS dict",
+                )
+            ]
+        doc_path = os.path.join(project.repo_root, "docs", "OPERATIONS.md")
+        if not os.path.exists(doc_path):
+            return []
+        with open(doc_path, encoding="utf-8") as f:
+            doc_lines = f.read().splitlines()
+        # scan ONLY the exit-code table: from the marker line to the end of
+        # its contiguous `|`-row block — other numeric-first-column tables
+        # elsewhere in the doc (wire sequences, HTTP statuses) are not rc
+        # contracts and must not trip the gate
+        doc_codes: Dict[int, int] = {}  # rc -> line number
+        in_section = False
+        in_table = False
+        for i, line in enumerate(doc_lines, start=1):
+            stripped = line.strip()
+            if not in_section:
+                if "exit-code table" in stripped.lower():
+                    in_section = True
+                continue
+            if stripped.startswith("|"):
+                in_table = True
+                m = self._ROW_RE.match(stripped)
+                if m:
+                    doc_codes[int(m.group(1))] = i
+            elif in_table:
+                break  # first non-row line after the table ends the scan
+        findings: List[Finding] = []
+        rel_doc = os.path.relpath(doc_path, os.getcwd())
+        for code in sorted(set(table) - set(doc_codes)):
+            findings.append(
+                Finding(
+                    self.id,
+                    rel_doc,
+                    1,
+                    0,
+                    f"rc {code} ({table[code]}) is in the exit_codes registry "
+                    "but missing from the OPERATIONS.md exit-code table",
+                )
+            )
+        for code in sorted(set(doc_codes) - set(table)):
+            findings.append(
+                Finding(
+                    self.id,
+                    rel_doc,
+                    doc_codes[code],
+                    0,
+                    f"rc {code} appears in the OPERATIONS.md exit-code table "
+                    "but not in the exit_codes registry — add it there first",
+                )
+            )
+        # the TPU wait-gate codes live in prose, not the table; they must
+        # still be documented
+        consts = _module_int_consts(reg_mod)
+        text = "\n".join(doc_lines)
+        for name in ("TPU_WAIT_DEADLINE", "TPU_WAIT_WEDGED"):
+            if name not in consts:
+                continue
+            # bounded so '65' inside '0.65', '1650' or '6.5e4' cannot satisfy
+            # the documentation requirement (\b alone still matches after a
+            # decimal point)
+            if not re.search(rf"(?<![\d.]){consts[name]}(?!\d)", text):
+                findings.append(
+                    Finding(
+                        self.id,
+                        rel_doc,
+                        1,
+                        0,
+                        f"registry code {name}={consts[name]} is not "
+                        "mentioned anywhere in OPERATIONS.md",
+                    )
+                )
+        return findings
+
+
+@register
+class UnknownFaultSeam(Rule):
+    id = "GL303"
+    title = "fault-seam name not in the faults.py registry"
+
+    _SPEC_RE = re.compile(r"^([A-Za-z_][\w]*(?:\.[\w]+)+)=([a-z][a-z-]*)(?=[:;,]|$)")
+
+    def _seams_and_kinds(
+        self, project: Project
+    ) -> Optional[Tuple[Set[str], Set[str], Module]]:
+        mod = project.module_by_suffix(FAULTS_SUFFIX)
+        if mod is None:
+            return None
+        seams: Set[str] = set()
+        kinds: Set[str] = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if isinstance(target, ast.Name) and isinstance(
+                    node.value, (ast.Tuple, ast.List)
+                ):
+                    values = {
+                        e.value
+                        for e in node.value.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    }
+                    if target.id == "SEAMS":
+                        seams = values
+                    elif target.id == "KINDS":
+                        kinds = values
+        if not seams or not kinds:
+            return None
+        return seams, kinds, mod
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        resolved = self._seams_and_kinds(project)
+        if resolved is None:
+            # only a finding if a faults.py exists but lacks the registry
+            mod = project.module_by_suffix(FAULTS_SUFFIX)
+            if mod is not None:
+                return [
+                    Finding(
+                        self.id,
+                        mod.rel,
+                        1,
+                        0,
+                        "resilience/faults.py defines no statically-readable "
+                        "SEAMS tuple — the seam registry is the single "
+                        "source of truth GL303 checks against",
+                    )
+                ]
+            return []
+        seams, kinds, _ = resolved
+        findings: List[Finding] = []
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                # .fire("site") / .fire_bytes("site", ...)
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("fire", "fire_bytes")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    site = node.args[0].value
+                    if site not in seams:
+                        findings.append(
+                            Finding(
+                                self.id,
+                                mod.rel,
+                                node.lineno,
+                                node.col_offset,
+                                f"fault seam {site!r} is not in "
+                                "resilience/faults.py::SEAMS — register it "
+                                "there (it is the drillable-surface "
+                                "inventory) or fix the typo",
+                            )
+                        )
+                # fault-spec strings: "<site>=<kind>[...]" (plain or the
+                # literal head of an f-string)
+                text = None
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    text = node.value
+                elif isinstance(node, ast.JoinedStr) and node.values:
+                    head = node.values[0]
+                    if isinstance(head, ast.Constant) and isinstance(
+                        head.value, str
+                    ):
+                        text = head.value
+                if text:
+                    for chunk in re.split(r"[;\n]", text):
+                        m = self._SPEC_RE.match(chunk.strip())
+                        if m and m.group(2) in kinds and m.group(1) not in seams:
+                            findings.append(
+                                Finding(
+                                    self.id,
+                                    mod.rel,
+                                    node.lineno,
+                                    node.col_offset,
+                                    f"fault spec names unknown seam "
+                                    f"{m.group(1)!r} (kinds matched "
+                                    f"{m.group(2)!r}) — not in "
+                                    "resilience/faults.py::SEAMS",
+                                )
+                            )
+        return findings

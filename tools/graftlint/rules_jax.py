@@ -1,0 +1,805 @@
+"""GL1xx — JAX/TPU hazard rules.
+
+GL101  tracer concretization inside jit-reachable code
+GL102  Python control flow on a traced value
+GL110  host sync on a designated hot path
+GL120  wall-clock-seeded RNG
+GL121  unseeded module-level RNG in library code
+GL122  set-iteration ordering feeding construction
+GL130  donation-after-use (reading an argument passed through a
+       ``donate_argnums`` position)
+
+GL101/GL102 run a module-local taint analysis: parameters of functions
+passed to ``jit``/``pjit``/``shard_map`` (and of functions those call, via
+the arguments actually passed) are tracers; concretizing one (``float()``,
+``np.asarray()``, ``.item()``) or branching Python control flow on one is a
+trace-time error or — worse — a silent per-call recompile. Heuristics that
+keep the rule quiet on correct code:
+
+- ``self``/``cls`` and keyword-only parameters are NOT tainted: this
+  codebase binds static program switches (``second_order``, ``msl_active``)
+  keyword-only via ``functools.partial`` at the jit boundary.
+- ``.shape``/``.ndim``/``.dtype``/``.size``, ``len()``, ``isinstance()``,
+  ``hasattr()`` and ``x is (not) None`` are static under tracing and
+  sanitize taint.
+"""
+
+import ast
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import (
+    Finding,
+    Module,
+    Project,
+    Rule,
+    call_name,
+    const_int,
+    dotted_name,
+    register,
+)
+
+JIT_WRAPPERS = {"jit", "pjit", "shard_map"}
+UNWRAPPERS = {"partial", "grad", "value_and_grad", "vmap", "pmap", "checkpoint", "remat"}
+SANITIZER_CALLS = {"len", "hasattr", "isinstance", "getattr", "callable", "type"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+CONCRETIZERS = {"float", "int", "bool", "complex"}
+CONCRETIZING_METHODS = {"item", "tolist", "numpy"}
+
+#: Designated hot paths for GL110: the dispatch/settle machinery where one
+#: stray host sync serializes the pipeline. Functions can also opt in with a
+#: ``# graftlint: hot-path`` marker on (or above) their ``def`` line.
+HOT_PATHS: Dict[str, Set[str]] = {
+    "experiment/runner.py": {"_train_epoch"},
+    "serving/engine.py": {"adapt_batch", "predict_batch"},
+    "serving/server.py": {"_dispatch"},
+}
+
+HOST_SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+
+#: np.random module-level draws that consult (and mutate) the hidden global
+#: generator — unseeded unless someone called np.random.seed, and shared
+#: across threads either way.
+NP_GLOBAL_RNG = {
+    "rand", "randn", "randint", "random", "random_sample", "ranf", "sample",
+    "choice", "shuffle", "permutation", "uniform", "normal", "standard_normal",
+    "beta", "binomial", "poisson", "exponential", "bytes",
+}
+STDLIB_RNG = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle", "sample",
+    "uniform", "gauss", "betavariate", "expovariate", "normalvariate",
+}
+WALL_CLOCKS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+RNG_CTORS = {"RandomState", "default_rng", "seed", "Generator", "PRNGKey", "key"}
+
+
+def _parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _enclosing(parents, node, kinds) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _is_numpy_call(module: Module, call: ast.Call, fn_names: Set[str]) -> bool:
+    """True for ``np.asarray(...)``-style calls where the root alias resolves
+    to numpy and the attribute is one of ``fn_names``."""
+    name = call_name(call)
+    if not name or "." not in name:
+        return False
+    root, rest = name.split(".", 1)
+    return module.resolve_root(root).startswith("numpy") and rest in fn_names
+
+
+class _FuncIndex:
+    """Top-level defs + methods of one module, with jit-target resolution."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.parents = _parent_map(module.tree)
+        self.top: Dict[str, ast.FunctionDef] = {}
+        self.methods: Dict[Tuple[str, str], ast.FunctionDef] = {}
+        for node in module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.top[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.methods[(node.name, sub.name)] = sub
+
+    def enclosing_class(self, node: ast.AST) -> Optional[str]:
+        cls = _enclosing(self.parents, node, (ast.ClassDef,))
+        return cls.name if cls is not None else None
+
+    def resolve_name(self, name: str, at: ast.AST) -> Optional[ast.FunctionDef]:
+        """A bare callable name, searched through enclosing function bodies
+        (nested defs) and then module top level."""
+        fn = _enclosing(self.parents, at, (ast.FunctionDef, ast.AsyncFunctionDef))
+        while fn is not None:
+            for stmt in ast.walk(fn):
+                if (
+                    isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and stmt.name == name
+                ):
+                    return stmt
+            fn = _enclosing(self.parents, fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+        return self.top.get(name)
+
+    def resolve_method(self, cls_name: Optional[str], name: str):
+        if cls_name is None:
+            return None
+        return self.methods.get((cls_name, name))
+
+    def resolve_callable(
+        self, expr: ast.AST, at: ast.AST, bound_kws: Set[str]
+    ) -> Optional[Tuple[ast.AST, Set[str]]]:
+        """Resolve the callable handed to a jit wrapper: a def/lambda plus
+        the set of keyword names statically bound via functools.partial."""
+        if isinstance(expr, ast.Lambda):
+            return expr, bound_kws
+        if isinstance(expr, ast.Name):
+            target = self.resolve_name(expr.id, at)
+            return (target, bound_kws) if target is not None else None
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id in ("self", "cls"):
+                target = self.resolve_method(self.enclosing_class(at), expr.attr)
+                return (target, bound_kws) if target is not None else None
+            return None
+        if isinstance(expr, ast.Call):
+            name = call_name(expr)
+            last = name.split(".")[-1] if name else ""
+            if last in UNWRAPPERS and expr.args:
+                kws = set(bound_kws)
+                if last == "partial":
+                    kws |= {kw.arg for kw in expr.keywords if kw.arg}
+                return self.resolve_callable(expr.args[0], at, kws)
+        return None
+
+
+def _seed_taint(fn: ast.AST, bound_kws: Set[str]) -> Set[str]:
+    """Tracer-tainted parameter names of a directly-jitted callable."""
+    args = fn.args
+    names = [a.arg for a in getattr(args, "posonlyargs", [])] + [
+        a.arg for a in args.args
+    ]
+    tainted = {n for n in names if n not in ("self", "cls")}
+    # keyword-only params are static switches by convention (partial-bound)
+    return tainted - bound_kws
+
+
+class _Analysis:
+    """One pass over a function body with a given tainted-parameter set.
+
+    Collects GL101/GL102 findings and (callee, tainted-params) propagations
+    for the module-level fixpoint."""
+
+    def __init__(self, module: Module, index: _FuncIndex, rule_ids: Tuple[str, str]):
+        self.module = module
+        self.index = index
+        self.gl_concrete, self.gl_flow = rule_ids
+        self.findings: List[Finding] = []
+        self.calls_out: List[Tuple[ast.AST, frozenset]] = []
+
+    # -- taint of an expression ----------------------------------------
+
+    def t(self, node: ast.AST, env: Dict[str, bool]) -> bool:
+        if isinstance(node, ast.Name):
+            return env.get(node.id, False)
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.t(node.value, env)
+        if isinstance(node, ast.Subscript):
+            return self.t(node.value, env) or self.t(node.slice, env)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators
+            ):
+                return False  # pytree-structure test, static under tracing
+            return self.t(node.left, env) or any(
+                self.t(c, env) for c in node.comparators
+            )
+        if isinstance(node, (ast.BinOp,)):
+            return self.t(node.left, env) or self.t(node.right, env)
+        if isinstance(node, ast.BoolOp):
+            return any(self.t(v, env) for v in node.values)
+        if isinstance(node, ast.UnaryOp):
+            return self.t(node.operand, env)
+        if isinstance(node, ast.IfExp):
+            return (
+                self.t(node.test, env)
+                or self.t(node.body, env)
+                or self.t(node.orelse, env)
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.t(e, env) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.t(v, env) for v in node.values if v is not None)
+        if isinstance(node, ast.Starred):
+            return self.t(node.value, env)
+        if isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            last = name.split(".")[-1]
+            if last in SANITIZER_CALLS or name in ("jnp.shape", "jnp.ndim"):
+                return False
+            root = name.split(".")[0] if name else ""
+            resolved = self.module.resolve_root(root)
+            if resolved.startswith("jax") or resolved in ("jax.numpy", "jax.lax"):
+                return True  # tracer-producing library call
+            return any(self.t(a, env) for a in node.args) or any(
+                self.t(kw.value, env) for kw in node.keywords
+            )
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            return any(self.t(g.iter, env) for g in node.generators)
+        if isinstance(node, ast.JoinedStr):
+            return False
+        if isinstance(node, ast.Lambda):
+            return False
+        return False
+
+    # -- statement walk -------------------------------------------------
+
+    def run(self, fn: ast.AST, tainted: Set[str]) -> None:
+        env: Dict[str, bool] = {name: True for name in tainted}
+        body = fn.body if not isinstance(fn, ast.Lambda) else [ast.Expr(fn.body)]
+        self._block(body, env)
+
+    def _bind_target(self, target: ast.AST, value_tainted: bool, env) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = value_tainted
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_target(elt, value_tainted, env)
+        elif isinstance(target, ast.Starred):
+            self._bind_target(target.value, value_tainted, env)
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(rule, self.module.rel, node.lineno, node.col_offset, msg)
+        )
+
+    def _check_call(self, call: ast.Call, env) -> None:
+        name = call_name(call) or ""
+        # concretizers: float(x) / int(x) / np.asarray(x) on a tracer
+        if isinstance(call.func, ast.Name) and call.func.id in CONCRETIZERS:
+            if any(self.t(a, env) for a in call.args):
+                self._flag(
+                    self.gl_concrete,
+                    call,
+                    f"{call.func.id}() concretizes a traced value inside a "
+                    "jit-compiled function (trace-time error or silent "
+                    "host sync)",
+                )
+        elif _is_numpy_call(self.module, call, {"asarray", "array", "copy"}):
+            if any(self.t(a, env) for a in call.args):
+                self._flag(
+                    self.gl_concrete,
+                    call,
+                    f"{name}() pulls a traced value to the host inside a "
+                    "jit-compiled function",
+                )
+        elif (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in CONCRETIZING_METHODS
+            and self.t(call.func.value, env)
+        ):
+            self._flag(
+                self.gl_concrete,
+                call,
+                f".{call.func.attr}() concretizes a traced value inside a "
+                "jit-compiled function",
+            )
+        # propagation into module-local callees
+        target = None
+        skip_self = 0
+        if isinstance(call.func, ast.Name):
+            target = self.index.resolve_name(call.func.id, call)
+        elif (
+            isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id in ("self", "cls")
+        ):
+            target = self.index.resolve_method(
+                self.index.enclosing_class(call), call.func.attr
+            )
+            skip_self = 1
+        if target is not None:
+            params = [a.arg for a in getattr(target.args, "posonlyargs", [])] + [
+                a.arg for a in target.args.args
+            ]
+            params = params[skip_self:]
+            callee_tainted: Set[str] = set()
+            for i, arg in enumerate(call.args):
+                if isinstance(arg, ast.Starred):
+                    continue
+                if i < len(params) and self.t(arg, env):
+                    callee_tainted.add(params[i])
+            kw_params = set(params) | {a.arg for a in target.args.kwonlyargs}
+            for kw in call.keywords:
+                if kw.arg and kw.arg in kw_params and self.t(kw.value, env):
+                    callee_tainted.add(kw.arg)
+            if callee_tainted:
+                self.calls_out.append((target, frozenset(callee_tainted)))
+
+    def _expr(self, node: ast.AST, env) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._check_call(sub, env)
+
+    def _block(self, stmts: List[ast.stmt], env: Dict[str, bool]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child = dict(env)
+                # nested defs inside traced code are called with tracers
+                # (scan/vmap bodies, loss closures): taint their params
+                for a in (
+                    list(getattr(stmt.args, "posonlyargs", []))
+                    + stmt.args.args
+                    + stmt.args.kwonlyargs
+                ):
+                    child[a.arg] = True
+                self._block(stmt.body, child)
+            elif isinstance(stmt, ast.Assign):
+                self._expr(stmt.value, env)
+                tainted = self.t(stmt.value, env)
+                for target in stmt.targets:
+                    self._bind_target(target, tainted, env)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._expr(stmt.value, env)
+                self._bind_target(stmt.target, self.t(stmt.value, env), env)
+            elif isinstance(stmt, ast.AugAssign):
+                self._expr(stmt.value, env)
+                if isinstance(stmt.target, ast.Name):
+                    env[stmt.target.id] = env.get(stmt.target.id, False) or self.t(
+                        stmt.value, env
+                    )
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self._expr(stmt.test, env)
+                if self.t(stmt.test, env):
+                    kind = "if" if isinstance(stmt, ast.If) else "while"
+                    self._flag(
+                        self.gl_flow,
+                        stmt,
+                        f"Python `{kind}` on a traced value inside a "
+                        "jit-compiled function — use lax.cond/lax.select "
+                        "(or hoist the switch to a static argument)",
+                    )
+                self._block(stmt.body, env)
+                self._block(stmt.orelse, env)
+            elif isinstance(stmt, ast.For):
+                self._expr(stmt.iter, env)
+                self._bind_target(stmt.target, self.t(stmt.iter, env), env)
+                self._block(stmt.body, env)
+                self._block(stmt.orelse, env)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self._expr(item.context_expr, env)
+                self._block(stmt.body, env)
+            elif isinstance(stmt, ast.Try):
+                self._block(stmt.body, env)
+                for handler in stmt.handlers:
+                    self._block(handler.body, env)
+                self._block(stmt.orelse, env)
+                self._block(stmt.finalbody, env)
+            elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                self._expr(stmt.value, env)
+            elif isinstance(stmt, (ast.Expr, ast.Assert, ast.Raise, ast.Delete)):
+                self._expr(stmt, env)
+            # Pass/Break/Continue/Import/Global/Nonlocal: nothing to do
+
+
+def _jit_seeds(module: Module, index: _FuncIndex):
+    """(funcdef-or-lambda, tainted-params) for every jit/pjit/shard_map
+    call site in the module."""
+    seeds = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node) or ""
+        if name.split(".")[-1] not in JIT_WRAPPERS or not node.args:
+            continue
+        resolved = index.resolve_callable(node.args[0], node, set())
+        if resolved is None:
+            continue
+        target, bound_kws = resolved
+        if isinstance(target, ast.Lambda):
+            tainted = {
+                a.arg
+                for a in list(getattr(target.args, "posonlyargs", []))
+                + target.args.args
+            }
+            seeds.append((target, frozenset(tainted - bound_kws)))
+        else:
+            seeds.append((target, frozenset(_seed_taint(target, bound_kws))))
+    return seeds
+
+
+def _tracer_findings(module: Module) -> List[Finding]:
+    """Run the shared GL101/GL102 taint fixpoint once per module (memoized on
+    the Module instance so selecting both rules doesn't pay twice) and return
+    ALL its findings; each rule class filters to its own id."""
+    cached = getattr(module, "_graftlint_tracer_findings", None)
+    if cached is not None:
+        return cached
+    findings: List[Finding] = []
+    index = _FuncIndex(module)
+    seeds = _jit_seeds(module, index)
+    if seeds:
+        contexts: Dict[ast.AST, Set[str]] = {}
+        work = deque(seeds)
+        iterations = 0
+        while work and iterations < 10_000:
+            iterations += 1
+            fn, params = work.popleft()
+            have = contexts.get(fn)
+            if have is not None and set(params) <= have:
+                continue
+            contexts[fn] = (have or set()) | set(params)
+            probe = _Analysis(module, index, ("GL101", "GL102"))
+            probe.run(fn, contexts[fn])
+            for callee, cparams in probe.calls_out:
+                work.append((callee, cparams))
+        seen = set()
+        for fn, tainted in contexts.items():
+            final = _Analysis(module, index, ("GL101", "GL102"))
+            final.run(fn, tainted)
+            for f in final.findings:
+                key = (f.rule, f.line, f.col)
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(f)
+    module._graftlint_tracer_findings = findings  # type: ignore[attr-defined]
+    return findings
+
+
+@register
+class TracerHazards(Rule):
+    id = "GL101"
+    title = "tracer concretization inside jit-reachable code"
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        return [f for f in _tracer_findings(module) if f.rule == self.id]
+
+
+@register
+class ControlFlowOnTracer(Rule):
+    id = "GL102"
+    title = "Python control flow on a traced value"
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        return [f for f in _tracer_findings(module) if f.rule == self.id]
+
+
+@register
+class HostSyncInHotPath(Rule):
+    id = "GL110"
+    title = "host sync on a hot path"
+
+    def _hot_functions(self, module: Module):
+        declared: Set[str] = set()
+        for suffix, names in HOT_PATHS.items():
+            if module.rel.endswith(suffix):
+                declared |= names
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+                node.name in declared or module.has_marker("hot-path", node.lineno)
+            ):
+                yield node
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn in self._hot_functions(module):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = None
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in HOST_SYNC_METHODS
+                ):
+                    msg = f".{node.func.attr}() blocks on the device"
+                elif _is_numpy_call(module, node, {"asarray", "array"}) and not (
+                    node.args
+                    and isinstance(node.args[0], (ast.List, ast.Tuple, ast.Constant))
+                ):
+                    # a literal display is host data by construction
+                    msg = f"{call_name(node)}() copies device memory to host"
+                elif (call_name(node) or "").endswith("device_get"):
+                    msg = "jax.device_get() synchronizes host and device"
+                elif isinstance(node.func, ast.Name) and node.func.id in (
+                    "float",
+                    "int",
+                ):
+                    if node.args and not isinstance(node.args[0], ast.Constant):
+                        msg = (
+                            f"{node.func.id}() on a device value forces a "
+                            "blocking transfer"
+                        )
+                if msg:
+                    findings.append(
+                        Finding(
+                            self.id,
+                            module.rel,
+                            node.lineno,
+                            node.col_offset,
+                            f"host sync inside hot path `{fn.name}`: {msg} "
+                            "(move off the dispatch loop, or suppress with "
+                            "a justification if the sync is the point)",
+                        )
+                    )
+        return findings
+
+
+@register
+class WallClockSeededRNG(Rule):
+    id = "GL120"
+    title = "wall-clock-seeded RNG"
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        findings = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            is_rng_ctor = name.split(".")[-1] in RNG_CTORS
+            seed_kwargs = [
+                kw.value
+                for kw in node.keywords
+                if kw.arg and ("seed" in kw.arg.lower())
+            ]
+            if not is_rng_ctor and not seed_kwargs:
+                continue
+            scan = list(node.args) + seed_kwargs if is_rng_ctor else seed_kwargs
+            for arg in scan:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Call) and (
+                        (call_name(sub) or "") in WALL_CLOCKS
+                    ):
+                        findings.append(
+                            Finding(
+                                self.id,
+                                module.rel,
+                                sub.lineno,
+                                sub.col_offset,
+                                "RNG seeded from the wall clock — every run "
+                                "(and every process of a multi-host job) "
+                                "draws a different stream; thread a seed "
+                                "from config instead",
+                            )
+                        )
+        return findings
+
+
+@register
+class UnseededModuleRNG(Rule):
+    id = "GL121"
+    title = "unseeded module-level RNG in library code"
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        findings = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            parts = name.split(".")
+            bad = None
+            if len(parts) >= 2:
+                root = module.resolve_root(parts[0])
+                if (
+                    root.startswith("numpy")
+                    and parts[-2] == "random"
+                    and parts[-1] in NP_GLOBAL_RNG
+                ):
+                    bad = name
+                elif root == "random" and parts[-1] in STDLIB_RNG:
+                    bad = name
+            elif len(parts) == 1:
+                resolved = module.resolve_root(parts[0])
+                if resolved.startswith("random.") and parts[0] in STDLIB_RNG:
+                    bad = resolved
+            if bad:
+                findings.append(
+                    Finding(
+                        self.id,
+                        module.rel,
+                        node.lineno,
+                        node.col_offset,
+                        f"{bad}() draws from the hidden global generator — "
+                        "unseeded (non-replayable) and shared across "
+                        "threads; use np.random.RandomState(seed) / "
+                        "default_rng(seed) plumbed from config",
+                    )
+                )
+        return findings
+
+
+@register
+class SetIterationOrder(Rule):
+    id = "GL122"
+    title = "set-iteration ordering feeding construction"
+
+    def _is_set_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            return node.func.id in ("set", "frozenset")
+        if isinstance(node, ast.BinOp):
+            return self._is_set_expr(node.left) or self._is_set_expr(node.right)
+        return False
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        findings = []
+        iters: List[ast.AST] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+            ):
+                iters.extend(g.iter for g in node.generators)
+        for it in iters:
+            if self._is_set_expr(it):
+                findings.append(
+                    Finding(
+                        self.id,
+                        module.rel,
+                        it.lineno,
+                        it.col_offset,
+                        "iterating a set: the order is arbitrary per process "
+                        "(hash randomization), so anything built from it — "
+                        "pytree leaves, schedules, file lists — is "
+                        "nondeterministic; sort it first",
+                    )
+                )
+        return findings
+
+
+@register
+class DonationAfterUse(Rule):
+    id = "GL130"
+    title = "donated buffer read after the donating call"
+
+    def _donated_positions(self, call: ast.Call) -> Optional[List[int]]:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if const_int(v) is not None:
+                    return [const_int(v)]
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    out = [const_int(e) for e in v.elts]
+                    if all(x is not None for x in out):
+                        return out  # type: ignore[return-value]
+                return None  # dynamic (config-driven): can't track statically
+        return None
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        findings = []
+        scopes = [module.tree] + [
+            n
+            for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            body_nodes = (
+                scope.body if isinstance(scope, ast.Module) else scope.body
+            )
+            donators: Dict[str, List[int]] = {}
+            # (varname, donated-at-line)
+            donated: Dict[str, int] = {}
+            events = []
+            own_defs = {
+                n
+                for stmt in body_nodes
+                for n in ast.walk(stmt)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            } - {scope}
+            skip: Set[ast.AST] = set()
+            for inner in own_defs:
+                skip.update(ast.walk(inner))
+            # Name-Store nodes that are targets of Assign-family statements:
+            # their rebind takes effect when the whole statement finishes, so
+            # the store event is anchored at the statement's END line — this
+            # keeps the canonical `state = fn(\n    state, ...)` multi-line
+            # rebind clean (the donate, at the call's end line, is cleared by
+            # the store at the same point)
+            assign_target_stores: Dict[ast.AST, int] = {}
+            for stmt in body_nodes:
+                for node in ast.walk(stmt):
+                    if node in skip:
+                        continue
+                    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                        end = getattr(node, "end_lineno", None) or node.lineno
+                        targets = (
+                            node.targets
+                            if isinstance(node, ast.Assign)
+                            else [node.target]
+                        )
+                        for t in targets:
+                            for sub in ast.walk(t):
+                                if isinstance(sub, ast.Name):
+                                    assign_target_stores[sub] = end
+            for stmt in body_nodes:
+                for node in ast.walk(stmt):
+                    if node in skip:
+                        continue  # nested defs are their own scopes
+                    if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call
+                    ):
+                        name = call_name(node.value) or ""
+                        if name.split(".")[-1] in JIT_WRAPPERS:
+                            pos = self._donated_positions(node.value)
+                            if pos:
+                                for t in node.targets:
+                                    if isinstance(t, ast.Name):
+                                        donators[t.id] = pos
+                    if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name
+                    ):
+                        pos = donators.get(node.func.id)
+                        if pos:
+                            # the buffer dies when the CALL completes — its
+                            # end line, so arg loads inside a multi-line
+                            # call never sort after their own donation
+                            end = getattr(node, "end_lineno", None) or node.lineno
+                            for p in pos:
+                                if p < len(node.args) and isinstance(
+                                    node.args[p], ast.Name
+                                ):
+                                    events.append(
+                                        ("donate", end, node.args[p].id)
+                                    )
+                    if isinstance(node, ast.Name):
+                        if node in assign_target_stores:
+                            events.append(
+                                ("store", assign_target_stores[node], node.id)
+                            )
+                        else:
+                            kind = (
+                                "store"
+                                if isinstance(node.ctx, (ast.Store, ast.Del))
+                                else "load"
+                            )
+                            events.append((kind, node.lineno, node.id))
+            # within one line the evaluation order is: arg loads, then the
+            # donating call, then the assignment store (which rebinds the
+            # name to the result, making the donated buffer unreachable)
+            events.sort(
+                key=lambda e: (e[1], {"load": 0, "donate": 1, "store": 2}[e[0]])
+            )
+            for kind, line, name in events:
+                if kind == "donate":
+                    donated[name] = line
+                elif kind == "store":
+                    donated.pop(name, None)
+                elif kind == "load" and name in donated and line > donated[name]:
+                    findings.append(
+                        Finding(
+                            self.id,
+                            module.rel,
+                            line,
+                            0,
+                            f"`{name}` was donated to a jit-compiled call "
+                            f"(donate_argnums, line {donated[name]}) and read "
+                            "afterwards — the buffer is dead; rebind the "
+                            "result or drop the donation",
+                        )
+                    )
+                    donated.pop(name, None)
+        return findings
