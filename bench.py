@@ -395,6 +395,41 @@ def main():
     print(f"bench: single-dispatch {single_steps_per_sec:.3f} steps/s",
           file=sys.stderr, flush=True)
 
+    # --- step-phase breakdown (observability/metrics.py): where host time
+    # goes per step, with the runner's one-dispatch-lag shape — dispatch =
+    # host-side program launch, settle = the LAGGED fetch of the previous
+    # step's loss (the pipeline's real device wait), data-wait ~0 here (the
+    # synthetic batch is resident) but reported so the BENCH json carries
+    # the same phase keys the run telemetry uses. A failure in this arm
+    # degrades to phase_breakdown=null, never costs the headline.
+    wd.enter("phase-breakdown", 300)
+    phase_breakdown = None
+    try:
+        import numpy as np
+
+        from howtotrainyourmamlpytorch_tpu.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        pending = None
+        for _ in range(12):
+            with reg.timer("phase.data_wait"):
+                step_batch = batch  # resident synthetic batch: no assembly
+            with reg.timer("phase.dispatch"):
+                state, out = system.train_step(state, step_batch, epoch=0)
+            if pending is not None:
+                with reg.timer("phase.settle"):
+                    np.asarray(pending)
+            pending = out.loss
+        with reg.timer("phase.settle"):
+            np.asarray(pending)
+        phase_breakdown = {
+            name: {"p50_ms": s["p50_ms"], "p95_ms": s["p95_ms"]}
+            for name, s in reg.summaries("phase.").items()
+        }
+    except Exception as e:
+        print(f"bench: phase breakdown unavailable: {e}", file=sys.stderr)
+    wd.update(phase_breakdown=phase_breakdown)
+
     # Multi-step dispatch (train_steps_per_dispatch=K in production): K outer
     # steps scanned inside ONE device call — amortizes the per-dispatch
     # host/RPC overhead, which over the tunnel rivals the device step itself.

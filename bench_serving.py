@@ -86,23 +86,39 @@ def main(argv=None) -> int:
     engine.adapt_batch([episode(i)[:2] for i in range(args.batch)])
     engine.predict_batch([(fw, x_q)] * args.batch)
 
+    # phase instrumentation (observability/metrics.py): data-wait = request
+    # payload assembly, dispatch = the headline predict engine call (host
+    # arrays back, settle inside), adapt_dispatch/settle = the async adapt
+    # launch and its drain — adapt and predict land in SEPARATE histograms
+    # so neither population can mask a regression in the other. Same
+    # registry machinery the run telemetry uses; the one-line BENCH json
+    # reports p50/p95 per phase.
+    from howtotrainyourmamlpytorch_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+
     # --- adapt latency (uncached: a fresh support set every rep) ---
     adapt_ms = []
     weights = []
     for i in range(args.adapt_reps):
-        x_s, y_s, _ = episode(100 + i)
+        with reg.timer("phase.data_wait"):
+            x_s, y_s, _ = episode(100 + i)
         t0 = time.perf_counter()
-        w = engine.adapt(x_s, y_s)
-        jax.block_until_ready(w)
+        with reg.timer("phase.adapt_dispatch"):
+            w = engine.adapt(x_s, y_s)
+        with reg.timer("phase.settle"):
+            jax.block_until_ready(w)
         adapt_ms.append((time.perf_counter() - t0) * 1e3)
         weights.append(w)
 
     # --- cached-predict latency (weights already adapted: predict only) ---
     predict_ms = []
     for i in range(args.predict_reps):
-        _, _, x_q = episode(200 + i)
+        with reg.timer("phase.data_wait"):
+            _, _, x_q = episode(200 + i)
         t0 = time.perf_counter()
-        engine.predict(weights[i % len(weights)], x_q)
+        with reg.timer("phase.dispatch"):
+            engine.predict(weights[i % len(weights)], x_q)
         predict_ms.append((time.perf_counter() - t0) * 1e3)
 
     # --- predict throughput at the micro-batch size ---
@@ -130,6 +146,10 @@ def main(argv=None) -> int:
         "micro_batch": args.batch,
         "model": f"vgg{stages}x{filters}",
         "compiled": engine.compile_counts(),
+        "phase_breakdown": {
+            name: {"p50_ms": s["p50_ms"], "p95_ms": s["p95_ms"]}
+            for name, s in reg.summaries("phase.").items()
+        },
     }
     print(json.dumps(result), flush=True)
     return 0

@@ -20,4 +20,4 @@ come from XLA autodiff.
 
 __version__ = "0.1.0"
 
-from . import analysis, config, core, data, experiment, models, ops, parallel, resilience, serving, utils  # noqa: F401
+from . import analysis, config, core, data, experiment, models, observability, ops, parallel, resilience, serving, utils  # noqa: F401

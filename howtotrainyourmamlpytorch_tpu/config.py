@@ -138,6 +138,47 @@ class ServingConfig:
 
 
 @dataclass
+class ObservabilityConfig:
+    """Unified telemetry knobs (``observability/`` package; no reference
+    equivalent — the reference logged epoch-level CSVs and nothing else).
+    Enabled, the runner and serving frontend record per-step phase spans
+    (data-wait / dispatch / settle / checkpoint / eval) into a bounded ring,
+    snapshot phase histograms + throughput to ``logs/telemetry.jsonl``, and
+    export a Chrome/Perfetto trace at run end. Disabled, every hook is a
+    shared no-op object and no file is created — the run is bit-identical
+    to a build without the subsystem (test-asserted)."""
+
+    enabled: bool = True
+    # per-phase histogram ring length (exact percentiles over this window)
+    histogram_window: int = 2048
+    # completed-span ring capacity; evictions counted, never unbounded growth
+    trace_capacity: int = 8192
+    # also snapshot every N settled steps (0 = per-epoch snapshots only).
+    # Per-step snapshots are for short diagnostic runs; at 500 iters/epoch
+    # the per-epoch cadence is the production default.
+    snapshot_every_steps: int = 0
+    # write logs/trace.json (Chrome trace-event JSON) when the run closes
+    export_chrome_trace: bool = True
+
+    def __post_init__(self):
+        if self.histogram_window < 1:
+            raise ValueError(
+                f"observability.histogram_window must be >= 1, "
+                f"got {self.histogram_window}"
+            )
+        if self.trace_capacity < 1:
+            raise ValueError(
+                f"observability.trace_capacity must be >= 1, "
+                f"got {self.trace_capacity}"
+            )
+        if self.snapshot_every_steps < 0:
+            raise ValueError(
+                f"observability.snapshot_every_steps must be >= 0, "
+                f"got {self.snapshot_every_steps}"
+            )
+
+
+@dataclass
 class WatchdogConfig:
     """Hang (wedge) supervisor knobs (``resilience/watchdog.py``). A device
     call that hangs instead of raising is invisible to every raise-based
@@ -415,6 +456,8 @@ class Config:
     serving: ServingConfig = field(default_factory=ServingConfig)
     # --- fault tolerance (resilience/ package; no reference equivalent) ---
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    # --- telemetry (observability/ package; no reference equivalent) ---
+    observability: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     compute_dtype: str = "float32"  # or "bfloat16" for MXU-friendly compute
     remat_inner_steps: bool = True  # jax.checkpoint per inner step (SURVEY §5.7)
     # Fully unroll the inner-step lax.scan: removes scan sequencing overhead
@@ -581,8 +624,8 @@ def _dataclass_from_dict(cls, data: Dict[str, Any]):
         if name not in data:
             continue
         value = data[name]
-        if name in ("dataset", "inner_optim", "parallel", "serving", "resilience"):
-            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig, "serving": ServingConfig, "resilience": ResilienceConfig}[name]
+        if name in ("dataset", "inner_optim", "parallel", "serving", "resilience", "observability"):
+            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig, "serving": ServingConfig, "resilience": ResilienceConfig, "observability": ObservabilityConfig}[name]
             presets = {"dataset": DATASET_PRESETS, "inner_optim": INNER_OPTIM_PRESETS}.get(name, {})
             if isinstance(value, str):
                 if value not in presets:

@@ -123,6 +123,18 @@ class MetaLearningDataLoader:
     def continue_from_iter(self, current_iter: int) -> None:
         self.train_episodes_produced = current_iter * self.batch_size
 
+    def stats(self) -> Dict[str, int]:
+        """Telemetry-provider snapshot (observability/telemetry.py): stream
+        position + transient-I/O retry count. ``io_retries_used`` is mutated
+        under ``_stats_lock`` by the window-pool threads, so read it there;
+        ``train_episodes_produced`` only moves on the consumer thread."""
+        with self._stats_lock:
+            retries = self.io_retries_used
+        return {
+            "train_episodes_produced": self.train_episodes_produced,
+            "io_retries_used": retries,
+        }
+
     # ------------------------------------------------------------------
 
     def _build_batch(self, split: str, base: int, augment: bool) -> Dict[str, np.ndarray]:

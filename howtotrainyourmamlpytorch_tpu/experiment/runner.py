@@ -40,6 +40,7 @@ from ..parallel import (
     requested_mesh_shape,
     shard_train_state,
 )
+from ..observability import TelemetryHub
 from ..resilience.faults import injector_from
 from ..resilience.watchdog import HeartbeatWatchdog
 from ..utils.trees import named_leaves
@@ -94,6 +95,17 @@ class ExperimentRunner:
         # the wedge path closes it explicitly before os._exit skips finally)
         # so post-mortems never lose the final events
         self.events = storage.EventLog(self.logs_dir)
+        # --- telemetry (config.py::ObservabilityConfig; observability/) ---
+        # span tracer + metrics registry + logs/telemetry.jsonl snapshots.
+        # Inert (shared no-op hooks, no files) when observability.enabled is
+        # false; providers are registered at the end of __init__ once the
+        # system/loader/watchdog exist.
+        self.hub = TelemetryHub.from_config(cfg.observability, logs_dir=self.logs_dir)
+        # compiled-program variants already dispatched once: the first
+        # dispatch of each variant pays its XLA compile, so its span (and
+        # the settle that drains it) is tagged cold=True — obs_report and
+        # percentile readers can separate compile outliers from steady state
+        self._variants_seen: set = set()
 
         # --- resilience (config.py::ResilienceConfig; resilience/ package) ---
         # fault injector (inert unless cfg.resilience.faults / HTYMP_FAULTS
@@ -301,7 +313,43 @@ class ExperimentRunner:
                 name="runner",
             )
 
+        # --- telemetry providers: live state embedded in every snapshot ---
+        if self.hub.enabled:
+            if self.system.recompile_guard is not None:
+                self.hub.add_provider(
+                    "recompile_guard", self.system.recompile_guard.snapshot
+                )
+            if self._watchdog is not None:
+                self.hub.add_provider(
+                    "watchdog_beat_age_s",
+                    lambda: round(self._watchdog.beat_age_s(), 3),
+                )
+            self.hub.add_provider("loader", self.loader.stats)
+            if self.degraded_mesh is not None:
+                self.hub.registry.set_gauge("degraded_mesh", self.degraded_mesh)
+
     # ------------------------------------------------------------------
+
+    def _traced_batches(self, iterable, epoch: int):
+        """Wrap a loader stream so time blocked on episode assembly is the
+        ``data_wait`` phase. The span closes before the batch is yielded, so
+        an abandoned iterator (preemption break) never leaves a span open."""
+        it = iter(iterable)
+        while True:
+            with self.hub.phase("data_wait", epoch=epoch):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            yield batch
+
+    def _note_variant(self, key) -> bool:
+        """True exactly once per compiled-program variant: the dispatch that
+        (on a cold cache) pays the XLA compile, tagged cold in the trace."""
+        if key in self._variants_seen:
+            return False
+        self._variants_seen.add(key)
+        return True
 
     def _put(self, batch: Dict[str, np.ndarray], sharding=None):
         if self.mesh is not None:
@@ -340,17 +388,23 @@ class ExperimentRunner:
         # by restoring the state captured before it; the episode stream
         # moves on past the bad batch.
         guard = res.nan_guard
-        pending = None  # (state_before, loss_dev, acc_dev, forced_nan)
+        pending = None  # (state_before, loss_dev, acc_dev, forced_nan, cold, episodes)
 
         def settle() -> bool:
             """Judge the pending dispatch; True = good (stats recorded)."""
             nonlocal pending
-            state_before, loss_dev, acc_dev, forced = pending
+            state_before, loss_dev, acc_dev, forced, cold, episodes = pending
             pending = None
-            # deliberate sync: the sentinel's one-dispatch-lag loss check IS
-            # a host fetch — one scalar per settled step, while dispatch i+1
-            # is already in flight  # graftlint: disable=GL110
-            loss_host = np.atleast_1d(np.asarray(jax.device_get(loss_dev)))
+            # the settle phase spans the LAGGED fetch of dispatch i-1 while
+            # dispatch i is already in flight — the pipeline's real
+            # device-wait, not a blocking fetch of the step just issued.
+            # cold marks the settle draining a first-compile dispatch.
+            with self.hub.phase("settle", epoch=epoch, cold=cold):
+                # deliberate sync: the sentinel's one-dispatch-lag loss check
+                # IS a host fetch — one scalar per settled step, while
+                # dispatch i+1 is already in flight
+                # graftlint: disable=GL110
+                loss_host = np.atleast_1d(np.asarray(jax.device_get(loss_dev)))
             # the fetch above is where a wedged device call hangs first —
             # completing it is the strongest liveness evidence there is
             self._beat(f"settle epoch {epoch}")
@@ -365,44 +419,60 @@ class ExperimentRunner:
             # CONSECUTIVE discards, not discards-since-last-rollback —
             # isolated NaNs hours apart must never add up to a rollback
             self._bad_steps = 0
+            self.hub.step_completed(episodes)
             return True
 
         preempted = False
         undispatched_iters = 0  # yielded by the loader but never dispatched
         if K > 1:
-            for chunk in self.loader.train_batch_chunks(
-                n_chunks, K, augment_images=True
+            chunk_episodes = K * self.loader.batch_size
+            for chunk in self._traced_batches(
+                self.loader.train_batch_chunks(n_chunks, K, augment_images=True),
+                epoch,
             ):
                 if self._preempt_signum is not None:
                     preempted = True
                     undispatched_iters = K
                     break
                 forced = self._injector.fire("runner.step") == "nan-loss"
-                put = self._put(
-                    chunk,
-                    self._chunk_sharding if self.mesh is not None else None,
+                cold = self._note_variant(
+                    ("multi", self.system.use_second_order(epoch),
+                     self.system.msl_active(epoch))
                 )
                 before = self.state
-                self.state, (chunk_losses, chunk_accs, chunk_lrs) = (
-                    self.system.train_step_multi(self.state, put, epoch)
-                )
+                # the dispatch phase is host-side work only — device
+                # placement + async program launch; device execution shows
+                # up in the NEXT iteration's settle span
+                with self.hub.phase("dispatch", epoch=epoch, cold=cold):
+                    put = self._put(
+                        chunk,
+                        self._chunk_sharding if self.mesh is not None else None,
+                    )
+                    self.state, (chunk_losses, chunk_accs, chunk_lrs) = (
+                        self.system.train_step_multi(self.state, put, epoch)
+                    )
                 self._beat(f"dispatch epoch {epoch}")
                 lr = chunk_lrs[-1]
                 if not guard:
                     losses.append(chunk_losses)
                     accs.append(chunk_accs)
+                    self.hub.step_completed(chunk_episodes)
                     continue
                 if pending is not None and not settle():
                     # settle() restored the pre-poison state, which also
                     # discards the dispatch we just issued on top of it
                     self._note_bad_step(epoch)
                     continue
-                pending = (before, chunk_losses, chunk_accs, forced)
+                pending = (before, chunk_losses, chunk_accs, forced, cold,
+                           chunk_episodes)
         else:
             single_iters = total_iters
         if not preempted:
             for it, batch in enumerate(
-                self.loader.train_batches(single_iters, augment_images=True)
+                self._traced_batches(
+                    self.loader.train_batches(single_iters, augment_images=True),
+                    epoch,
+                )
             ):
                 if self._preempt_signum is not None:
                     preempted = True
@@ -411,12 +481,17 @@ class ExperimentRunner:
                 if profile_this_epoch and it == prof_start:
                     jax.profiler.start_trace(cfg.profile_dir)
                 forced = self._injector.fire("runner.step") == "nan-loss"
+                cold = self._note_variant(
+                    ("single", self.system.use_second_order(epoch),
+                     self.system.msl_active(epoch))
+                )
                 before = self.state
                 # epoch passed host-side: program-variant selection without a
                 # device sync, so step dispatch overlaps episode assembly
-                self.state, out = self.system.train_step(
-                    self.state, self._put(batch), epoch=epoch
-                )
+                with self.hub.phase("dispatch", epoch=epoch, cold=cold):
+                    self.state, out = self.system.train_step(
+                        self.state, self._put(batch), epoch=epoch
+                    )
                 self._beat(f"dispatch epoch {epoch}")
                 if profile_this_epoch and it == prof_stop - 1:
                     # drain before stop_trace so the profiled window captures
@@ -429,11 +504,13 @@ class ExperimentRunner:
                 if not guard:
                     losses.append(out.loss)
                     accs.append(out.accuracy)
+                    self.hub.step_completed(self.loader.batch_size)
                     continue
                 if pending is not None and not settle():
                     self._note_bad_step(epoch)
                     continue
-                pending = (before, out.loss, out.accuracy, forced)
+                pending = (before, out.loss, out.accuracy, forced, cold,
+                           self.loader.batch_size)
         # drain the lagged check (also before an emergency save: the saved
         # state must be a settled-good one)
         if pending is not None and not settle():
@@ -531,8 +608,13 @@ class ExperimentRunner:
             )
         except Exception:
             pass
-        # os._exit skips finally blocks: close the event log here or the
-        # post-mortem loses its own final lines
+        # os._exit skips finally blocks: flush telemetry (final snapshot +
+        # trace export — all host-side, so safe from this thread) and close
+        # the event log here or the post-mortem loses its own final lines
+        try:
+            self.hub.close()
+        except Exception:
+            pass
         self.events.close()
 
     def _place_state(self, host_state: TrainState) -> TrainState:
@@ -690,18 +772,24 @@ class ExperimentRunner:
             # multi-host path stays per-batch: it gathers each [B_global]
             # array across processes)
             stacked = _stack(list(batches))  # [{k: [B,...]}] -> {k: [N,B,...]}
-            put = self._put(
-                stacked, self._chunk_sharding if self.mesh is not None else None
-            )
-            losses, accs = jax.device_get(
-                self.system.eval_step_multi(self.state, put)
-            )
+            with self.hub.phase(
+                "eval", split=split, cold=self._note_variant(("eval_fused",))
+            ):
+                put = self._put(
+                    stacked, self._chunk_sharding if self.mesh is not None else None
+                )
+                losses, accs = jax.device_get(
+                    self.system.eval_step_multi(self.state, put)
+                )
             return _episode_stats(
                 split, np.concatenate(losses), np.concatenate(accs)
             )
         ep_losses, ep_accs = [], []
         for batch in batches:
-            out = self.system.eval_step(self.state, self._put(batch))
+            with self.hub.phase(
+                "eval", split=split, cold=self._note_variant(("eval",))
+            ):
+                out = self.system.eval_step(self.state, self._put(batch))
             self._beat(f"eval {split}")
             ep_losses.append(out.per_task_losses)
             ep_accs.append(out.per_task_accuracies)
@@ -745,20 +833,21 @@ class ExperimentRunner:
             "train_episodes_produced": self.loader.train_episodes_produced,
             "val_acc_by_epoch": {str(k): v for k, v in self.val_acc_by_epoch.items()},
         }
-        host_state = jax.device_get(self.state)
-        ckpt.save_checkpoint(
-            self.saved_models_dir,
-            host_state,
-            bookkeeping,
-            epoch,
-            self.cfg.max_models_to_save,
-            val_acc_by_epoch=(
-                self.val_acc_by_epoch
-                if self.cfg.checkpoint_rotation == "best_val"
-                else None
-            ),
-            injector=self._injector,
-        )
+        with self.hub.phase("checkpoint", epoch=epoch):
+            host_state = jax.device_get(self.state)
+            ckpt.save_checkpoint(
+                self.saved_models_dir,
+                host_state,
+                bookkeeping,
+                epoch,
+                self.cfg.max_models_to_save,
+                val_acc_by_epoch=(
+                    self.val_acc_by_epoch
+                    if self.cfg.checkpoint_rotation == "best_val"
+                    else None
+                ),
+                injector=self._injector,
+            )
         # this durable state is the new NaN-rollback anchor, and (with its
         # bookkeeping) the wedge watchdog's emergency-checkpoint anchor
         self._last_good = host_state
@@ -806,7 +895,10 @@ class ExperimentRunner:
         identical episodes — assembled once by the caller)."""
         probs = []
         for batch in batches:
-            out = self.system.eval_step(state, self._put(batch))
+            with self.hub.phase(
+                "eval", split="test-ensemble", cold=self._note_variant(("eval",))
+            ):
+                out = self.system.eval_step(state, self._put(batch))
             self._beat("eval test-ensemble")
             probs.append(self._gather_array(jax.nn.softmax(out.per_task_target_logits, axis=-1)))
         return probs
@@ -888,6 +980,10 @@ class ExperimentRunner:
         finally:
             if self._watchdog is not None:
                 self._watchdog.stop()
+            # final telemetry snapshot + Chrome-trace export on every
+            # non-wedge exit path (telemetry.jsonl itself is flushed per
+            # append, so the rc=76 os._exit only costs the trace file)
+            self.hub.close()
             # flush + close events.jsonl on every non-wedge exit path
             # (normal, rc=3 abort, rc=75 preemption, errors); the rc=76
             # wedge path closes it itself before os._exit
@@ -918,6 +1014,13 @@ class ExperimentRunner:
                 self.best_val_epoch = epoch
                 self._save_best()
             self._save(epoch)
+            # after eval + checkpoint so the epoch snapshot's cumulative
+            # phase sums include every phase of this epoch
+            self.hub.snapshot(
+                "epoch",
+                epoch=epoch,
+                train_wall_s=round(float(stats["epoch_run_time"]), 3),
+            )
             # a preemption signal that landed during eval/save: the epoch
             # checkpoint just written is complete, so exit restartable
             # without an extra emergency save

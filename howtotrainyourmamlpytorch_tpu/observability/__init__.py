@@ -1,0 +1,33 @@
+"""Unified observability: span tracing, metrics registry, run telemetry.
+
+The first subsystem that spans both stacks: the training runner and the
+serving frontend instrument their hot paths through the same three pieces —
+
+- :mod:`trace` — ``SpanTracer``: low-overhead thread-safe span recorder
+  (bounded ring, injectable clock, per-thread nesting) with Chrome
+  trace-event / Perfetto JSON export and a balance validator the chaos
+  campaign runs over every exported trace;
+- :mod:`metrics` — ``MetricsRegistry``: counters, gauges, and windowed
+  histograms with exact percentiles (window copied under the lock, numpy
+  math outside it). ``serving/metrics.py``'s ``LatencyStats`` /
+  ``EventCounters`` are thin adapters over it, ``/metrics`` schema
+  unchanged;
+- :mod:`telemetry` — ``TelemetryHub``: snapshots the registry to
+  ``logs/telemetry.jsonl`` per epoch / per-N steps (episodes/s throughput,
+  step-phase histograms, provider snapshots: recompile guard, watchdog beat
+  age, breaker state).
+
+Knobs: ``Config.observability`` (``config.py::ObservabilityConfig``) —
+fully inert and bit-identical when disabled. Report CLI:
+``scripts/obs_report.py``; howto: ``docs/OPERATIONS.md`` "Reading a run".
+"""
+
+from .metrics import MetricsRegistry  # noqa: F401
+from .telemetry import NULL_HUB, TelemetryHub  # noqa: F401
+from .trace import (  # noqa: F401
+    NULL_TRACER,
+    NullTracer,
+    SpanTracer,
+    load_and_validate_trace,
+    validate_chrome_trace,
+)

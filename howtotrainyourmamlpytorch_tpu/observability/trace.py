@@ -1,0 +1,273 @@
+"""Low-overhead thread-safe span tracer with Chrome-trace export.
+
+The training hot loop dispatches thousands of steps per epoch; the serving
+path flushes micro-batches from worker threads. Both need *where does the
+time go* answered without perturbing what they measure, so the tracer is
+deliberately minimal: a ``span(name)`` context manager costs two clock reads
+and two lock acquisitions, completed spans land in a bounded ring buffer
+(old spans evicted, never an unbounded list growing for 150 epochs), and
+nesting depth is tracked per thread so exported traces render as a proper
+flame graph. The clock is injectable so tests walk time deterministically.
+
+Export is the Chrome trace-event JSON format (``{"traceEvents": [...]}``,
+complete ``"ph": "X"`` events with microsecond ``ts``/``dur``), which both
+``chrome://tracing`` and Perfetto (https://ui.perfetto.dev) open directly.
+Complete events are balanced by construction — a span only reaches the ring
+when its ``__exit__`` ran — and :func:`validate_chrome_trace` re-checks that
+plus the schema, which the chaos campaign runs over every exported trace.
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class _NullSpan:
+    """Shared no-op context manager: the disabled tracer's ``span()`` must
+    cost one attribute lookup and nothing else."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """Inert tracer: every hook is a no-op. Instrumented code holds one of
+    these when observability is disabled, so call sites never branch."""
+
+    enabled = False
+
+    def span(self, name: str, **tags):
+        return _NULL_SPAN
+
+    def records(self) -> List[dict]:
+        return []
+
+    def durations_s(self, name: str) -> List[float]:
+        return []
+
+    def open_spans(self) -> int:
+        return 0
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        return {"traceEvents": []}
+
+    def export(self, path: str) -> None:
+        pass
+
+
+NULL_TRACER = NullTracer()
+
+
+class _Span:
+    """Live span handed out by :meth:`SpanTracer.span`; records itself into
+    the tracer's ring on exit. ``duration_s`` is set on exit so wrappers
+    (the hub's phase histograms) reuse the span's own clock pair instead of
+    reading the clock again — one measurement, two consumers."""
+
+    __slots__ = ("_tracer", "name", "tags", "_t0", "_depth", "duration_s")
+
+    def __init__(self, tracer: "SpanTracer", name: str, tags: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.tags = tags
+
+    def __enter__(self):
+        self._depth = self._tracer._enter()
+        self._t0 = self._tracer._clock()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = self._tracer._clock()
+        self.duration_s = t1 - self._t0
+        self._tracer._exit_record(self.name, self._t0, t1, self._depth, self.tags)
+        return False
+
+
+class SpanTracer:
+    """Bounded-ring span recorder.
+
+    ``capacity`` bounds the completed-span ring; evictions are counted in
+    ``dropped`` so a truncated export is visible as truncated rather than
+    passing for the whole run. ``clock`` must be monotonic; tests inject a
+    fake. Completed spans are ``(name, t0, t1, thread_name, depth, tags)``
+    tuples relative to the tracer's epoch (construction time).
+    """
+
+    enabled = True
+
+    def __init__(self, capacity: int = 8192, clock: Callable[[], float] = time.monotonic):
+        if capacity < 1:
+            raise ValueError(f"tracer capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._clock = clock
+        self._epoch = clock()
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._local = threading.local()
+        self.dropped = 0
+        self._open = 0
+
+    # -- span lifecycle (called from _Span) ----------------------------
+
+    def _enter(self) -> int:
+        depth = getattr(self._local, "depth", 0)
+        self._local.depth = depth + 1
+        with self._lock:
+            self._open += 1
+        return depth
+
+    def _exit_record(self, name, t0, t1, depth, tags) -> None:
+        self._local.depth = depth
+        rec = (name, t0 - self._epoch, t1 - self._epoch,
+               threading.current_thread().name, depth, tags)
+        with self._lock:
+            self._open -= 1
+            if len(self._ring) == self.capacity:
+                self.dropped += 1
+            self._ring.append(rec)
+
+    # -- public API ----------------------------------------------------
+
+    def span(self, name: str, **tags) -> _Span:
+        """``with tracer.span("dispatch", epoch=3): ...``"""
+        return _Span(self, name, tags)
+
+    def open_spans(self) -> int:
+        """Spans entered but not yet exited, across all threads — zero when
+        every ``with`` block has unwound (the balance invariant)."""
+        with self._lock:
+            return self._open
+
+    def records(self) -> List[dict]:
+        """Snapshot of the ring as dicts (seconds relative to tracer epoch)."""
+        with self._lock:
+            ring = list(self._ring)
+        return [
+            {"name": n, "t0_s": t0, "t1_s": t1, "dur_s": t1 - t0,
+             "thread": thread, "depth": depth, "tags": tags}
+            for n, t0, t1, thread, depth, tags in ring
+        ]
+
+    def durations_s(self, name: str) -> List[float]:
+        with self._lock:
+            ring = list(self._ring)
+        return [t1 - t0 for n, t0, t1, _, _, _ in ring if n == name]
+
+    # -- export --------------------------------------------------------
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """Chrome trace-event JSON object. Only completed (balanced) spans
+        are exported; in-flight spans and ring evictions are surfaced as
+        metadata so a partial trace reads as partial."""
+        with self._lock:
+            ring = list(self._ring)
+            open_spans = self._open
+            dropped = self.dropped
+        events: List[Dict[str, Any]] = []
+        tids: Dict[str, int] = {}
+        for name, t0, t1, thread, depth, tags in ring:
+            tid = tids.setdefault(thread, len(tids))
+            event = {
+                "name": name,
+                "cat": "host",
+                "ph": "X",
+                "ts": round(t0 * 1e6, 3),
+                "dur": round((t1 - t0) * 1e6, 3),
+                "pid": 0,
+                "tid": tid,
+            }
+            if tags:
+                # viewer 'args' values must be JSON scalars; stringify the rest
+                event["args"] = {
+                    k: (v if isinstance(v, (int, float, bool, str, type(None))) else str(v))
+                    for k, v in tags.items()
+                }
+            events.append(event)
+        for thread, tid in tids.items():
+            events.append(
+                {"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+                 "args": {"name": thread}}
+            )
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"open_spans": open_spans, "dropped_spans": dropped},
+        }
+
+    def export(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+
+
+# ---------------------------------------------------------------------------
+# trace validation (the chaos-campaign invariant)
+# ---------------------------------------------------------------------------
+
+_REQUIRED_X_KEYS = ("name", "ph", "ts", "dur", "pid", "tid")
+
+
+def validate_chrome_trace(trace: Dict[str, Any]) -> List[str]:
+    """Schema + balance check of an exported trace; returns violations
+    (empty = valid). Accepts the object form (``{"traceEvents": [...]}``).
+    Balance means: every duration event is complete (``"X"`` with a
+    non-negative ``dur``), any ``"B"``/``"E"`` pairs match per (pid, tid),
+    and the exporter left no span open."""
+    problems: List[str] = []
+    if not isinstance(trace, dict) or not isinstance(trace.get("traceEvents"), list):
+        return ["trace is not an object with a traceEvents list"]
+    begin_depth: Dict[Tuple[Any, Any], int] = {}
+    for i, ev in enumerate(trace["traceEvents"]):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i} is not an object")
+            continue
+        ph = ev.get("ph")
+        if ph == "M":
+            continue
+        if ph == "X":
+            missing = [k for k in _REQUIRED_X_KEYS if k not in ev]
+            if missing:
+                problems.append(f"event {i} missing keys {missing}")
+                continue
+            if not isinstance(ev["ts"], (int, float)) or ev["ts"] < 0:
+                problems.append(f"event {i} has bad ts {ev['ts']!r}")
+            if not isinstance(ev["dur"], (int, float)) or ev["dur"] < 0:
+                problems.append(f"event {i} has negative/bad dur {ev['dur']!r}")
+        elif ph == "B":
+            key = (ev.get("pid"), ev.get("tid"))
+            begin_depth[key] = begin_depth.get(key, 0) + 1
+        elif ph == "E":
+            key = (ev.get("pid"), ev.get("tid"))
+            depth = begin_depth.get(key, 0) - 1
+            if depth < 0:
+                problems.append(f"event {i}: 'E' without matching 'B' on {key}")
+            begin_depth[key] = depth
+        else:
+            problems.append(f"event {i} has unsupported ph {ph!r}")
+    for key, depth in begin_depth.items():
+        if depth > 0:
+            problems.append(f"{depth} unclosed 'B' span(s) on {key}")
+    open_spans = (trace.get("otherData") or {}).get("open_spans", 0)
+    if open_spans:
+        problems.append(f"exporter reported {open_spans} span(s) still open")
+    return problems
+
+
+def load_and_validate_trace(path: str) -> List[str]:
+    """Parse + validate an exported trace file; unparseable JSON is itself
+    the violation."""
+    try:
+        with open(path) as f:
+            trace = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        return [f"trace unreadable: {exc}"]
+    return validate_chrome_trace(trace)

@@ -17,6 +17,10 @@ after every episode — the things that must hold no matter which fault fired:
 4. **serving honesty** — a request either succeeds with a well-formed
    payload or fails with a documented error class / HTTP status; shedding,
    breaker rejections and deadline expiries are never dressed up as 200s.
+5. **telemetry integrity** — every line of ``logs/telemetry.jsonl`` parses
+   as JSON, and every exported Chrome trace (``logs/trace.json``) passes
+   the schema + balanced-spans validator — the observability layer must
+   stay readable through exactly the deaths it exists to explain.
 
 The campaign is deterministic in ``seed``: the same seed replays the same
 episode sequence with the same fault triggers (the injector's own
@@ -32,6 +36,7 @@ in-process for speed and compile-cache reuse.
 """
 
 import dataclasses
+import glob
 import json
 import os
 import subprocess
@@ -265,6 +270,40 @@ def _check_events_jsonl(run_dir: str) -> Optional[str]:
             except json.JSONDecodeError as exc:
                 return f"events.jsonl line {i + 1} unparseable: {exc}"
     return None
+
+
+def _check_telemetry(run_dir: str) -> List[str]:
+    """Invariant 5: telemetry.jsonl is well-formed JSON-lines and any
+    exported Chrome trace passes the schema + balanced-spans validator.
+    Either file may be absent (observability disabled, or a death before
+    the first snapshot / before trace export) — absence is fine, a torn or
+    unbalanced artifact is the finding."""
+    from ..observability.trace import load_and_validate_trace
+
+    problems: List[str] = []
+    tel_path = os.path.join(run_dir, "logs", "telemetry.jsonl")
+    if os.path.exists(tel_path):
+        with open(tel_path) as f:
+            for i, line in enumerate(f):
+                if not line.strip():
+                    continue
+                try:
+                    json.loads(line)
+                except json.JSONDecodeError as exc:
+                    problems.append(
+                        f"telemetry.jsonl line {i + 1} unparseable: {exc}"
+                    )
+    # current export plus any per-session archives (a resumed run renames
+    # the previous session's trace — e.g. a wedge post-mortem — aside
+    # rather than clobbering it; all of them must stay loadable)
+    for trace_path in sorted(
+        glob.glob(os.path.join(run_dir, "logs", "trace*.json"))
+    ):
+        problems.extend(
+            f"{os.path.basename(trace_path)}: {p}"
+            for p in load_and_validate_trace(trace_path)
+        )
+    return problems
 
 
 def _check_checkpoints(run_dir: str, template_state) -> Optional[str]:
@@ -608,6 +647,7 @@ def run_campaign(
             err = _check_events_jsonl(run_dir)
             if err:
                 ep_viol.append(err)
+            ep_viol.extend(_check_telemetry(run_dir))
             seen_events = _events_in(run_dir)
             for required in ep.required_events:
                 if required not in seen_events:
@@ -637,6 +677,7 @@ def run_campaign(
             "latest-or-fallback checkpoint loads",
             "events.jsonl well-formed",
             "serving never 200s a shed/failed payload",
+            "telemetry.jsonl well-formed + exported traces balanced",
         ],
         "episode_results": results,
         "elapsed_s": round(time.time() - t0, 1),

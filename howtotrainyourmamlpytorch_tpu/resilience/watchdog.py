@@ -127,6 +127,13 @@ class HeartbeatWatchdog:
             if stage is not None:
                 self._stage = stage
 
+    def beat_age_s(self) -> float:
+        """Seconds since the last beat (or arm) — the telemetry hub embeds
+        this in snapshots so a run drifting toward its wedge deadline is
+        visible in telemetry.jsonl long before the watchdog fires."""
+        with self._lock:
+            return self._clock() - self._last_beat
+
     # -- arming --------------------------------------------------------
 
     def arm(self, stage: Optional[str] = None) -> None:

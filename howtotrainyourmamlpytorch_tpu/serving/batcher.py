@@ -16,6 +16,8 @@ from collections import OrderedDict
 from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Dict, Hashable, List, Tuple
 
+from ..observability.trace import NULL_TRACER
+
 
 class QueueFullError(RuntimeError):
     """submit() refused: the batcher already holds ``max_queue_depth``
@@ -39,10 +41,14 @@ class MicroBatcher:
         deadline_ms: float,
         name: str = "batcher",
         max_queue_depth: int = None,
+        tracer=None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self._flush_fn = flush_fn
+        # flush spans on the worker thread (observability/trace.py); the
+        # shared NULL_TRACER default keeps the un-instrumented path free
+        self._tracer = tracer if tracer is not None else NULL_TRACER
         self.max_batch = int(max_batch)
         self.deadline_s = float(deadline_ms) / 1000.0
         self.max_queue_depth = None if max_queue_depth is None else int(max_queue_depth)
@@ -207,7 +213,10 @@ class MicroBatcher:
             with self._lock:
                 self.in_flight = len(group)
             try:
-                results = self._flush_fn(key, payloads)
+                with self._tracer.span(
+                    f"serve.flush.{self.name}", batch=len(group), bucket=key
+                ):
+                    results = self._flush_fn(key, payloads)
                 if len(results) != len(group):
                     raise RuntimeError(
                         f"{self.name} flush_fn returned {len(results)} results "

@@ -27,6 +27,7 @@ import numpy as np
 from ..config import Config, ServingConfig, load_config
 from ..core import MAMLSystem, TrainState
 from ..experiment import checkpoint as ckpt
+from ..observability.trace import NULL_TRACER
 from ..resilience.faults import injector_from
 
 
@@ -69,10 +70,15 @@ class AdaptationEngine:
         fingerprint: Optional[str] = None,
         injector=None,
         strict: Optional[bool] = None,
+        tracer=None,
     ):
         self.system = system
         self.cfg = system.cfg
         self.serving = serving_cfg or self.cfg.serving
+        # span tracer for the dispatch hot path (observability/trace.py);
+        # NULL_TRACER costs one attribute lookup per span. ServingFrontend
+        # swaps its hub's tracer in when observability is enabled.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         # fault seam 'serving.dispatch' fires at the head of every batched
         # device dispatch — the drill lever for the frontend's circuit
         # breaker (resilience/breaker.py). Default: built from the run
@@ -241,7 +247,8 @@ class AdaptationEngine:
         while len(xs) < b:  # pad the task axis by replicating the last task
             xs.append(xs[-1]); ys.append(ys[-1]); ws.append(ws[-1])
         fn = self._compiled_adapt(bucket, b)
-        stacked = fn(np.stack(xs), np.stack(ys), np.stack(ws))
+        with self.tracer.span("serve.adapt_dispatch", batch=n, bucket=bucket):
+            stacked = fn(np.stack(xs), np.stack(ys), np.stack(ws))
         return [jax.tree.map(lambda a, i=i: a[i], stacked) for i in range(n)]
 
     def adapt(self, x_support, y_support):
@@ -271,10 +278,11 @@ class AdaptationEngine:
             xs.append(xs[-1]); ws.append(ws[-1]); trees.append(trees[-1])
         stacked_fw = jax.tree.map(lambda *leaves: jnp.stack(leaves), *trees)
         fn = self._compiled_predict(bucket, b)
-        # deliberate sync: predictions must land host-side to serialize back
-        # to clients — this is the flush's one device round-trip
-        # graftlint: disable=GL110
-        probs = np.asarray(fn(stacked_fw, np.stack(xs), np.stack(ws)))
+        with self.tracer.span("serve.predict_dispatch", batch=n, bucket=bucket):
+            # deliberate sync: predictions must land host-side to serialize
+            # back to clients — this is the flush's one device round-trip
+            # graftlint: disable=GL110
+            probs = np.asarray(fn(stacked_fw, np.stack(xs), np.stack(ws)))
         return [probs[i, : sizes[i]] for i in range(n)]
 
     def predict(self, fast_weights, x_query) -> np.ndarray:

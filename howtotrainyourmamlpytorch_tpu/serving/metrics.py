@@ -1,56 +1,53 @@
-"""Serving latency metrics: per-phase ring buffers -> p50/p95/p99.
+"""Serving latency metrics: thin adapters over the shared MetricsRegistry.
 
-Same spirit as ``utils/profiling.py`` (measure, don't guess), but for the
-request path: each phase ("adapt", "adapt_cached", "predict", "queue") keeps
-a bounded window of wall-clock latencies; ``summary()`` is the ``/metrics``
-payload. A ring buffer (not a running histogram) keeps percentiles exact over
-the recent window and forgets cold-start compiles at window pace.
+Same request-path surface as before (``LatencyStats`` per-phase p50/p95/p99,
+``EventCounters`` for the resilience counts) and the exact same ``/metrics``
+payload schema, but the storage now lives in one
+:class:`~..observability.metrics.MetricsRegistry` — the same registry the
+TelemetryHub snapshots — instead of a private island. The registry also
+fixes the old lock shape: ``summary()`` used to compute numpy percentiles
+*inside* the recording lock, so every recorder thread (HTTP handlers,
+batcher workers) blocked behind a ``/metrics`` scrape; the registry copies
+each phase window under the lock and runs the percentile math after
+releasing it.
 """
 
-import threading
 import time
-from collections import deque
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
-import numpy as np
+from ..observability.metrics import MetricsRegistry
+
+#: registry namespaces the adapters write under — one registry can host both
+#: (plus the hub's ``phase.*`` histograms) without key collisions
+LATENCY_PREFIX = "serving.latency."
+EVENTS_PREFIX = "serving.events."
 
 
 class LatencyStats:
-    def __init__(self, window: int = 2048):
+    """Per-phase latency percentiles ("adapt", "adapt_cached", "predict",
+    "queue"): a bounded window of wall-clock seconds per phase, exact
+    percentiles over the recent window (cold-start compiles forgotten at
+    window pace). ``summary()`` is the ``/metrics`` payload — schema
+    unchanged from the pre-registry implementation."""
+
+    def __init__(self, window: int = 2048, registry: Optional[MetricsRegistry] = None):
         self.window = int(window)
-        self._lock = threading.Lock()
-        self._phases: Dict[str, deque] = {}
-        self._counts: Dict[str, int] = {}
+        self.registry = registry if registry is not None else MetricsRegistry()
 
     def record(self, phase: str, seconds: float) -> None:
-        with self._lock:
-            buf = self._phases.get(phase)
-            if buf is None:
-                buf = self._phases[phase] = deque(maxlen=self.window)
-                self._counts[phase] = 0
-            buf.append(seconds)
-            self._counts[phase] += 1
+        self.registry.observe(LATENCY_PREFIX + phase, seconds, window=self.window)
 
     def time(self, phase: str):
         """Context manager: ``with stats.time("adapt"): ...``"""
         return _Timer(self, phase)
 
     def summary(self) -> Dict[str, Dict[str, Any]]:
-        with self._lock:
-            out = {}
-            for phase, buf in self._phases.items():
-                arr = np.asarray(buf, np.float64) * 1e3
-                p50, p95, p99 = np.percentile(arr, [50, 95, 99])
-                out[phase] = {
-                    "count": self._counts[phase],
-                    "window": len(arr),
-                    "mean_ms": round(float(arr.mean()), 3),
-                    "p50_ms": round(float(p50), 3),
-                    "p95_ms": round(float(p95), 3),
-                    "p99_ms": round(float(p99), 3),
-                    "max_ms": round(float(arr.max()), 3),
-                }
-            return out
+        out = self.registry.summaries(LATENCY_PREFIX)
+        for stats in out.values():
+            # the registry adds a cumulative sum; /metrics keeps its
+            # historical per-phase key set exactly
+            stats.pop("sum_ms", None)
+        return out
 
 
 class EventCounters:
@@ -58,21 +55,17 @@ class EventCounters:
     deadline misses, breaker rejections, dispatch failures) — the numbers the
     OPERATIONS.md degraded-modes runbook reads off ``/metrics``."""
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {}
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
 
     def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + n
+        self.registry.inc(EVENTS_PREFIX + name, n)
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._counts.get(name, 0)
+        return self.registry.counter(EVENTS_PREFIX + name)
 
     def snapshot(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self._counts)
+        return self.registry.counters(EVENTS_PREFIX)
 
 
 class _Timer:

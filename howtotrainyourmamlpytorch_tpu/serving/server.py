@@ -33,6 +33,8 @@ import numpy as np
 
 from ..config import Config, ResilienceConfig, ServingConfig
 from ..exit_codes import HTTP_DEADLINE, HTTP_UNAVAILABLE
+from ..observability import TelemetryHub
+from ..observability.trace import NULL_TRACER
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.retry import DeadlineExceededError
 from ..resilience.watchdog import HeartbeatWatchdog
@@ -64,17 +66,38 @@ class ServingFrontend:
         resilience_cfg: Optional[ResilienceConfig] = None,
         clock=time.monotonic,
         wedge_exit=None,
+        hub: Optional[TelemetryHub] = None,
     ):
         self.engine = engine
         self.serving = serving_cfg or engine.serving
         # resilience knobs ride the run config like the serving knobs do;
         # clock is injectable so breaker tests walk cooldowns without waiting
         self.resilience = resilience_cfg or engine.cfg.resilience
+        # one TelemetryHub per frontend (no logs dir — a server owns no run
+        # directory; tracer + registry only, snapshot on demand). The SAME
+        # registry backs the LatencyStats/EventCounters adapters, so the
+        # /metrics payload and the hub read one set of numbers.
+        self.hub = (
+            hub
+            if hub is not None
+            else TelemetryHub.from_config(
+                getattr(engine.cfg, "observability", None)
+            )
+        )
         self.cache = AdaptedWeightCache(
             max_bytes=self.serving.cache_max_bytes, ttl_s=self.serving.cache_ttl_s
         )
-        self.latency = LatencyStats(self.serving.latency_window)
-        self.counters = EventCounters()
+        self.latency = LatencyStats(
+            self.serving.latency_window, registry=self.hub.registry
+        )
+        self.counters = EventCounters(registry=self.hub.registry)
+        if self.hub.enabled:
+            # trace the engine's device dispatches and both batchers' flushes
+            # through the hub's tracer (engines built standalone keep their
+            # own tracer if one was injected)
+            if self.engine.tracer is NULL_TRACER:
+                self.engine.tracer = self.hub.tracer
+            self.hub.add_provider("breaker", lambda: self.breaker.snapshot())
         self.breaker = CircuitBreaker(
             failure_threshold=self.resilience.breaker_failure_threshold,
             cooldown_s=self.resilience.breaker_cooldown_s,
@@ -88,6 +111,7 @@ class ServingFrontend:
             deadline_ms=self.serving.batch_deadline_ms,
             name="adapt",
             max_queue_depth=self.resilience.max_queue_depth,
+            tracer=self.hub.tracer,
         )
         self._predict_batcher = MicroBatcher(
             lambda bucket, payloads: self.engine.predict_batch(payloads),
@@ -95,6 +119,7 @@ class ServingFrontend:
             deadline_ms=self.serving.batch_deadline_ms,
             name="predict",
             max_queue_depth=self.resilience.max_queue_depth,
+            tracer=self.hub.tracer,
         )
         self._started = time.monotonic()
         self._closed = False
@@ -219,14 +244,15 @@ class ServingFrontend:
 
     def adapt(self, x_support, y_support) -> Dict[str, Any]:
         t0 = time.monotonic()
-        x, y = self.engine._flatten_support(x_support, y_support)
-        digest = support_digest(x, y, self.engine.num_steps)
-        key = self._cache_key(digest)
-        cached = self.cache.get(key) is not None
-        if not cached:
-            bucket = self.engine.support_bucket(x.shape[0])
-            fast_weights = self._dispatch(self._adapt_batcher, bucket, (x, y))
-            self.cache.put(key, fast_weights)
+        with self.hub.span("serve.adapt"):
+            x, y = self.engine._flatten_support(x_support, y_support)
+            digest = support_digest(x, y, self.engine.num_steps)
+            key = self._cache_key(digest)
+            cached = self.cache.get(key) is not None
+            if not cached:
+                bucket = self.engine.support_bucket(x.shape[0])
+                fast_weights = self._dispatch(self._adapt_batcher, bucket, (x, y))
+                self.cache.put(key, fast_weights)
         elapsed = time.monotonic() - t0
         self.latency.record("adapt_cached" if cached else "adapt", elapsed)
         return {
@@ -238,15 +264,16 @@ class ServingFrontend:
 
     def predict(self, adaptation_id: str, x_query) -> np.ndarray:
         t0 = time.monotonic()
-        fast_weights = self.cache.get(self._cache_key(adaptation_id))
-        if fast_weights is None:
-            raise UnknownAdaptationError(
-                f"unknown or expired adaptation_id {adaptation_id!r}; "
-                "re-send the support set via /adapt"
-            )
-        x = np.asarray(x_query, np.float32)
-        bucket = self.engine.query_bucket(x.shape[0])
-        probs = self._dispatch(self._predict_batcher, bucket, (fast_weights, x))
+        with self.hub.span("serve.predict"):
+            fast_weights = self.cache.get(self._cache_key(adaptation_id))
+            if fast_weights is None:
+                raise UnknownAdaptationError(
+                    f"unknown or expired adaptation_id {adaptation_id!r}; "
+                    "re-send the support set via /adapt"
+                )
+            x = np.asarray(x_query, np.float32)
+            bucket = self.engine.query_bucket(x.shape[0])
+            probs = self._dispatch(self._predict_batcher, bucket, (fast_weights, x))
         self.latency.record("predict", time.monotonic() - t0)
         return probs
 

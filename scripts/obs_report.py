@@ -1,0 +1,355 @@
+#!/usr/bin/env python
+"""Unified run report: telemetry.jsonl + events.jsonl + xplane device time.
+
+Joins the three telemetry surfaces a run leaves behind into one report:
+
+- ``logs/telemetry.jsonl`` (observability/telemetry.py) — step-phase
+  histograms (data-wait / dispatch / settle / checkpoint / eval), throughput
+  in episodes/s, provider snapshots (recompile guard, watchdog beat age);
+- ``logs/events.jsonl`` (experiment/storage.py EventLog) — the resilience
+  event stream (NaN skips/rollbacks, preemptions, wedges, degraded mesh);
+- the ``jax.profiler`` xplane trace (``profile_dir``), when one was written —
+  the XLA device-time breakdown (compute/dma fractions, measured FLOPs)
+  that ``utils/profiling.py`` parses.
+
+Host-phase coverage is the report's honesty check: the train-loop phase sums
+(data-wait + dispatch + settle) over the summed epoch wall-clock. Near 1.0
+the phase table explains the run; a low ratio means time is going somewhere
+the phases don't span — say so rather than pretend.
+
+Usage::
+
+    python scripts/obs_report.py exps/<run> [--json] [--oneline]
+        [--chrome-trace out.json] [--xplane-dir DIR]
+
+``--json`` emits the full machine-readable report, ``--oneline`` one compact
+JSON line (what ``scripts/sweep.sh`` appends per finished run),
+``--chrome-trace`` copies the run's exported span trace (``logs/trace.json``,
+Chrome/Perfetto-loadable) to the given path.
+
+Import-light by design (stdlib + file-path-loaded repo modules; no jax, no
+package import): a report over a finished run dir must never touch — or wait
+on — a backend.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import shutil
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO_ROOT, "howtotrainyourmamlpytorch_tpu")
+
+#: train-loop phases whose sums are compared against epoch wall-clock; eval
+#: and checkpoint run outside the timed train loop
+TRAIN_LOOP_PHASES = ("data_wait", "dispatch", "settle")
+
+
+def _load_by_path(name: str, path: str):
+    """File-path module load (the wait_for_tpu.py pattern): keeps this CLI
+    free of the heavy package import (which pulls jax)."""
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+try:
+    exit_codes = _load_by_path("htymp_exit_codes", os.path.join(_PKG, "exit_codes.py"))
+    _RC_OK, _RC_USAGE = exit_codes.OK, exit_codes.USAGE
+except Exception:  # standalone copy of scripts/: the historical literals hold
+    _RC_OK, _RC_USAGE = 0, 2
+
+
+def _read_jsonl(path: str):
+    """Parse a jsonl file, skipping (and counting) torn lines: a run killed
+    hard mid-append leaves a partial final line, and this report must
+    degrade on exactly those runs, never die on them."""
+    records: List[Dict[str, Any]] = []
+    torn = 0
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                torn += 1
+    return records, torn
+
+
+def _device_breakdown(xplane_dir: Optional[str]) -> Optional[Dict[str, Any]]:
+    if not xplane_dir or not os.path.isdir(xplane_dir):
+        return None
+    try:
+        profiling = _load_by_path(
+            "htymp_profiling", os.path.join(_PKG, "utils", "profiling.py")
+        )
+        return profiling.device_time_breakdown(xplane_dir)
+    except Exception as exc:  # noqa: BLE001 — the join degrades, never dies
+        return {"error": f"xplane parse failed: {exc!r}"}
+
+
+def _profile_dir_from_config(run_dir: str) -> Optional[str]:
+    """``profile_dir`` out of the run's saved config.yaml without a yaml
+    dependency surprise: the value is a plain scalar on its own line."""
+    path = os.path.join(run_dir, "config.yaml")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        for line in f:
+            if line.startswith("profile_dir:"):
+                value = line.split(":", 1)[1].strip().strip("'\"")
+                return value or None
+    return None
+
+
+def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, Any]:
+    logs_dir = os.path.join(run_dir, "logs")
+    tel_path = os.path.join(logs_dir, "telemetry.jsonl")
+    report: Dict[str, Any] = {
+        "report": "obs",
+        "run_dir": run_dir,
+        "run": os.path.basename(os.path.normpath(run_dir)),
+    }
+    if not os.path.exists(tel_path):
+        report["error"] = (
+            "no logs/telemetry.jsonl — run predates the observability "
+            "subsystem or had observability.enabled=false"
+        )
+        return report
+
+    snapshots, torn = _read_jsonl(tel_path)
+    if torn:
+        report["torn_telemetry_lines"] = torn
+    if not snapshots:
+        report["error"] = (
+            "logs/telemetry.jsonl holds no parseable snapshot "
+            "(run died before its first snapshot, or every line is torn)"
+        )
+        return report
+    # a resumed run APPENDS a fresh process session to the same
+    # telemetry.jsonl, and each session's cumulative counters restart —
+    # phase sums and wall-clock must be compared within ONE session, never
+    # a suffix against the whole file. Snapshots carry a per-process
+    # "session" id; split on it, falling back to a counter-reset heuristic
+    # for id-less records.
+    sessions: List[List[Dict[str, Any]]] = [[]]
+    prev = None
+    for record in snapshots:
+        if prev is not None:
+            if "session" in record or "session" in prev:
+                new_session = record.get("session") != prev.get("session")
+            else:
+                new_session = (
+                    float(record.get("elapsed_s") or 0.0)
+                    < float(prev.get("elapsed_s") or 0.0)
+                    or int(record.get("steps") or 0) < int(prev.get("steps") or 0)
+                )
+            if new_session:
+                sessions.append([])
+        sessions[-1].append(record)
+        prev = record
+    session = sessions[-1]  # report the latest process session
+    epochs_all = [s for s in snapshots if s.get("kind") == "epoch"]
+    epochs = [s for s in session if s.get("kind") == "epoch"]
+    last = session[-1]
+    phases = last.get("phases", {})
+    report.update(
+        {
+            "snapshots": len(snapshots),
+            "sessions": len(sessions),
+            "epochs": len(epochs_all),
+            "session_epochs": len(epochs),
+            "steps": last.get("steps"),
+            "episodes": last.get("episodes"),
+            "episodes_per_s": last.get("episodes_per_s"),
+            "elapsed_s": last.get("elapsed_s"),
+            "phases": phases,
+            "providers": last.get("providers", {}),
+            "dropped_spans": last.get("dropped_spans", 0),
+        }
+    )
+
+    # host-phase coverage vs the SAME session's epoch wall-clock (the
+    # honesty check)
+    train_wall_s = sum(float(e.get("train_wall_s") or 0.0) for e in epochs)
+    loop_sum_s = sum(
+        float(phases.get(p, {}).get("sum_ms") or 0.0) / 1e3
+        for p in TRAIN_LOOP_PHASES
+    )
+    report["train_wall_s"] = round(train_wall_s, 3)
+    report["train_phase_sum_s"] = round(loop_sum_s, 3)
+    report["phase_coverage"] = (
+        round(loop_sum_s / train_wall_s, 3) if train_wall_s > 0 else None
+    )
+
+    # events.jsonl: counts by name + the resilience-notable subset
+    events_path = os.path.join(logs_dir, "events.jsonl")
+    if os.path.exists(events_path):
+        event_records, torn_events = _read_jsonl(events_path)
+        if torn_events:
+            report["torn_event_lines"] = torn_events
+        counts: Dict[str, int] = {}
+        for record in event_records:
+            name = record.get("event", "epoch_stats")
+            counts[name] = counts.get(name, 0) + 1
+        report["events"] = counts
+        notable = {
+            k: v
+            for k, v in counts.items()
+            if k in ("nan_step_skipped", "nan_rollback", "nan_abort",
+                     "preempted", "wedged", "wedge_checkpoint",
+                     "degraded_mesh", "early_abort")
+        }
+        if notable:
+            report["notable_events"] = notable
+
+    xplane_dir = xplane_dir or _profile_dir_from_config(run_dir)
+    breakdown = _device_breakdown(xplane_dir)
+    if breakdown is not None:
+        report["device_breakdown"] = breakdown
+
+    trace_path = os.path.join(logs_dir, "trace.json")
+    report["trace_path"] = trace_path if os.path.exists(trace_path) else None
+    return report
+
+
+def oneline(report: Dict[str, Any]) -> str:
+    """One compact JSON line per run for sweep logs."""
+    phases = report.get("phases", {})
+    slim = {
+        "report": "obs",
+        "run": report.get("run"),
+        "error": report.get("error"),
+        "epochs": report.get("epochs"),
+        "episodes_per_s": report.get("episodes_per_s"),
+        "phase_coverage": report.get("phase_coverage"),
+        "phase_p50_ms": {k: v.get("p50_ms") for k, v in phases.items()},
+        "notable_events": report.get("notable_events"),
+    }
+    return json.dumps({k: v for k, v in slim.items() if v is not None})
+
+
+def render_human(report: Dict[str, Any]) -> str:
+    lines = [f"== run report: {report.get('run')} =="]
+    if report.get("error"):
+        lines.append(f"ERROR: {report['error']}")
+        return "\n".join(lines)
+    lines.append(
+        f"epochs {report['epochs']}  steps {report['steps']}  "
+        f"episodes {report['episodes']}  "
+        f"throughput {report['episodes_per_s']} episodes/s  "
+        f"elapsed {report['elapsed_s']}s"
+    )
+    if report.get("sessions", 1) > 1:
+        lines.append(
+            f"({report['sessions']} process sessions in telemetry.jsonl — "
+            f"resumed run; steps/phases below are the last session's "
+            f"{report['session_epochs']} epoch(s))"
+        )
+    phases = report.get("phases", {})
+    if phases:
+        lines.append("-- step phases (host) --")
+        lines.append(
+            f"{'phase':<12} {'count':>7} {'p50 ms':>9} {'p95 ms':>9} "
+            f"{'max ms':>9} {'sum s':>9}"
+        )
+        for name in sorted(phases):
+            s = phases[name]
+            lines.append(
+                f"{name:<12} {s['count']:>7} {s['p50_ms']:>9} {s['p95_ms']:>9} "
+                f"{s['max_ms']:>9} {round(s['sum_ms'] / 1e3, 2):>9}"
+            )
+        cov = report.get("phase_coverage")
+        lines.append(
+            f"train-loop phase sum {report['train_phase_sum_s']}s over "
+            f"{report['train_wall_s']}s epoch wall-clock"
+            + (f" (coverage {cov})" if cov is not None else "")
+        )
+        if cov is not None and not 0.9 <= cov <= 1.1:
+            lines.append(
+                "  NOTE: coverage outside [0.9, 1.1] — phase spans do not "
+                "account for the train loop; trust the trace, not this table"
+            )
+    if report.get("events"):
+        lines.append("-- events.jsonl --")
+        lines.append(
+            "  " + "  ".join(f"{k}={v}" for k, v in sorted(report["events"].items()))
+        )
+        if report.get("notable_events"):
+            lines.append(
+                "  notable: "
+                + "  ".join(f"{k}={v}" for k, v in sorted(report["notable_events"].items()))
+            )
+    dev = report.get("device_breakdown")
+    if dev and "error" not in dev:
+        lines.append("-- device time (xplane) --")
+        lines.append(
+            f"  busy {dev.get('device_busy_ms')}ms: compute {dev.get('compute_frac')} "
+            f"dma {dev.get('dma_frac')} other {dev.get('other_frac')}"
+        )
+    elif dev:
+        lines.append(f"-- device time: {dev['error']}")
+    providers = report.get("providers") or {}
+    if providers:
+        lines.append("-- providers (last snapshot) --")
+        for name, value in sorted(providers.items()):
+            lines.append(f"  {name}: {json.dumps(value)}")
+    if report.get("trace_path"):
+        lines.append(
+            f"Chrome trace: {report['trace_path']} "
+            "(open in chrome://tracing or https://ui.perfetto.dev; "
+            "or --chrome-trace OUT to copy it)"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("run_dir", help="experiment run directory (exps/<name>)")
+    parser.add_argument("--json", action="store_true", help="full JSON report")
+    parser.add_argument(
+        "--oneline", action="store_true", help="one compact JSON line (sweep logs)"
+    )
+    parser.add_argument(
+        "--chrome-trace",
+        metavar="OUT",
+        help="copy the run's exported span trace (logs/trace.json) here",
+    )
+    parser.add_argument(
+        "--xplane-dir",
+        help="jax.profiler trace dir for the device-time join "
+        "(default: the run config's profile_dir)",
+    )
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"obs_report: no such run dir: {args.run_dir}", file=sys.stderr)
+        return _RC_USAGE
+    report = build_report(args.run_dir, xplane_dir=args.xplane_dir)
+    if args.chrome_trace:
+        src = report.get("trace_path")
+        if src:
+            shutil.copyfile(src, args.chrome_trace)
+            report["chrome_trace_written"] = args.chrome_trace
+        else:
+            print(
+                "obs_report: no logs/trace.json to export "
+                "(observability disabled, or the run died before export)",
+                file=sys.stderr,
+            )
+            return _RC_USAGE
+    if args.oneline:
+        print(oneline(report))
+    elif args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_human(report))
+    return _RC_OK if "error" not in report else _RC_USAGE
+
+
+if __name__ == "__main__":
+    sys.exit(main())

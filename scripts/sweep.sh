@@ -64,7 +64,14 @@ run () {
     done
     wait $pid; rc=$?
     echo "=== $(date -u +%H:%M:%S) $name attempt=$attempt rc=$rc" >> exps/sweep_r3.log
-    [ $rc -eq 0 ] && return 0
+    if [ $rc -eq 0 ]; then
+      # one-line observability summary (throughput, phase p50s, coverage,
+      # notable resilience events) next to the rc line — where the time of
+      # the finished run went, without opening the run dir
+      python scripts/obs_report.py "exps/${name}" --oneline >> exps/sweep_r3.log 2>&1 \
+        || echo "=== obs_report failed for $name (non-fatal)" >> exps/sweep_r3.log
+      return 0
+    fi
     if [ $rc -eq 3 ]; then
       # runner's divergence abort (early-abort OR exhausted NaN-rollback
       # ladder): permanent, not a transient failure — retrying resumes the

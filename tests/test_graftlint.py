@@ -583,6 +583,28 @@ def test_self_gate_shipped_tree_has_zero_unsuppressed_findings():
     assert len(suppressed) <= 20, [f.format() for f in suppressed]
 
 
+def test_self_gate_covers_observability_paths_explicitly():
+    """The observability package and the obs_report CLI sit inside the
+    self-gate on their own terms: zero unsuppressed findings even if the
+    top-level path list above is ever restructured. The span helpers run on
+    the GL110-designated dispatch/settle hot paths, so this is the gate
+    that keeps them sync-free."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join("howtotrainyourmamlpytorch_tpu", "observability"),
+                os.path.join("scripts", "obs_report.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in observability paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_catches_an_introduced_true_positive(tmp_path):
     """End-to-end: drop one fixture true positive next to real package code
     and the CLI must exit 1 with a GL id on stdout."""

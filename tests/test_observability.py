@@ -1,0 +1,441 @@
+"""Observability subsystem: tracer semantics under a fake clock, registry
+thread-safety, Chrome-trace schema/balance validation, hub snapshot cadence,
+and the runner e2e contract — all five step phases in telemetry.jsonl with
+plausible durations, a loadable balanced trace, and bit-identical training
+with the subsystem switched off."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from howtotrainyourmamlpytorch_tpu.config import (
+    Config,
+    DatasetConfig,
+    ObservabilityConfig,
+    ParallelConfig,
+)
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
+from howtotrainyourmamlpytorch_tpu.experiment.storage import load_statistics
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+from howtotrainyourmamlpytorch_tpu.observability import (
+    NULL_HUB,
+    MetricsRegistry,
+    SpanTracer,
+    TelemetryHub,
+    validate_chrome_trace,
+)
+from howtotrainyourmamlpytorch_tpu.serving.metrics import EventCounters, LatencyStats
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_nesting_and_durations_fake_clock():
+    clock = FakeClock()
+    tracer = SpanTracer(capacity=16, clock=clock)
+    with tracer.span("outer", epoch=0):
+        clock.advance(1.0)
+        with tracer.span("inner"):
+            clock.advance(0.25)
+        clock.advance(0.5)
+    recs = {r["name"]: r for r in tracer.records()}
+    assert recs["inner"]["depth"] == 1
+    assert recs["outer"]["depth"] == 0
+    assert recs["inner"]["dur_s"] == pytest.approx(0.25)
+    assert recs["outer"]["dur_s"] == pytest.approx(1.75)
+    assert recs["outer"]["tags"] == {"epoch": 0}
+    # inner completed first: ring order is completion order
+    assert [r["name"] for r in tracer.records()] == ["inner", "outer"]
+    assert tracer.open_spans() == 0
+    assert tracer.durations_s("inner") == pytest.approx([0.25])
+
+
+def test_tracer_ring_eviction_bounded_and_counted():
+    clock = FakeClock()
+    tracer = SpanTracer(capacity=3, clock=clock)
+    for i in range(5):
+        with tracer.span(f"s{i}"):
+            clock.advance(0.1)
+    recs = tracer.records()
+    assert len(recs) == 3  # bounded: oldest evicted, never unbounded growth
+    assert [r["name"] for r in recs] == ["s2", "s3", "s4"]
+    assert tracer.dropped == 2
+    # eviction is visible in the export too
+    assert tracer.to_chrome_trace()["otherData"]["dropped_spans"] == 2
+
+
+def test_tracer_thread_spans_keep_independent_nesting():
+    clock = FakeClock()
+    tracer = SpanTracer(capacity=64, clock=clock)
+    errors = []
+
+    def worker():
+        try:
+            with tracer.span("w_outer"):
+                with tracer.span("w_inner"):
+                    pass
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    with tracer.span("main_outer"):
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors
+    recs = tracer.records()
+    # worker nesting never inherits the main thread's open span
+    assert all(r["depth"] == 0 for r in recs if r["name"] == "w_outer")
+    assert all(r["depth"] == 1 for r in recs if r["name"] == "w_inner")
+    assert tracer.open_spans() == 0
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace export + validation
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_schema_valid_and_balanced(tmp_path):
+    clock = FakeClock()
+    tracer = SpanTracer(capacity=16, clock=clock)
+    with tracer.span("a", bucket=(25, 8)):  # non-scalar tag must stringify
+        clock.advance(0.5)
+    path = str(tmp_path / "trace.json")
+    tracer.export(path)
+    with open(path) as f:
+        trace = json.load(f)
+    assert validate_chrome_trace(trace) == []
+    (event,) = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert event["dur"] == pytest.approx(0.5e6)  # microseconds
+    assert event["args"]["bucket"] == "(25, 8)"
+    assert isinstance(event["tid"], int) and event["pid"] == 0
+
+
+def test_chrome_trace_validator_rejects_malformed():
+    assert validate_chrome_trace({"traceEvents": "nope"}) != []
+    bad_dur = {"traceEvents": [
+        {"name": "x", "ph": "X", "ts": 0, "dur": -1, "pid": 0, "tid": 0}
+    ]}
+    assert any("dur" in p for p in validate_chrome_trace(bad_dur))
+    missing = {"traceEvents": [{"name": "x", "ph": "X", "ts": 0}]}
+    assert any("missing keys" in p for p in validate_chrome_trace(missing))
+    unbalanced = {"traceEvents": [
+        {"name": "x", "ph": "B", "ts": 0, "pid": 0, "tid": 0}
+    ]}
+    assert any("unclosed" in p for p in validate_chrome_trace(unbalanced))
+    open_spans = {"traceEvents": [], "otherData": {"open_spans": 2}}
+    assert any("still open" in p for p in validate_chrome_trace(open_spans))
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_thread_safety_exact_counts():
+    reg = MetricsRegistry()
+    n_threads, n_iters = 8, 500
+
+    def worker(tid):
+        for i in range(n_iters):
+            reg.inc("hits")
+            reg.observe("lat", 0.001 * (i + 1), window=64)
+            reg.set_gauge(f"g{tid}", i)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counter("hits") == n_threads * n_iters  # no lost updates
+    summary = reg.summaries()["lat"]
+    assert summary["count"] == n_threads * n_iters  # cumulative past eviction
+    assert summary["window"] == 64
+
+
+def test_registry_summaries_window_and_cumulative_sum():
+    reg = MetricsRegistry()
+    for v in (0.010, 0.020, 0.030, 0.040):
+        reg.observe("phase.settle", v, window=2)
+    s = reg.summaries("phase.")["settle"]
+    # window keeps the last 2 (30ms, 40ms); count/sum are cumulative
+    assert s["count"] == 4
+    assert s["window"] == 2
+    assert s["p50_ms"] == pytest.approx(35.0)
+    assert s["max_ms"] == pytest.approx(40.0)
+    assert s["sum_ms"] == pytest.approx(100.0)
+
+
+def test_latency_stats_adapter_schema_unchanged():
+    """The /metrics contract: per-phase keys exactly as the pre-registry
+    LatencyStats emitted them (no registry-internal keys leaking out)."""
+    stats = LatencyStats(window=8)
+    stats.record("adapt", 0.010)
+    with stats.time("predict"):
+        pass
+    out = stats.summary()
+    assert set(out) == {"adapt", "predict"}
+    assert set(out["adapt"]) == {
+        "count", "window", "mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms"
+    }
+    assert out["adapt"]["count"] == 1
+
+
+def test_event_counters_adapter_shares_registry():
+    reg = MetricsRegistry()
+    counters = EventCounters(registry=reg)
+    latency = LatencyStats(window=4, registry=reg)
+    counters.inc("shed")
+    counters.inc("shed", 2)
+    latency.record("adapt", 0.005)
+    assert counters.get("shed") == 3
+    assert counters.snapshot() == {"shed": 3}
+    # namespaces keep the two adapters collision-free on one registry
+    assert "adapt" in latency.summary()
+
+
+# ---------------------------------------------------------------------------
+# hub
+# ---------------------------------------------------------------------------
+
+
+def test_null_hub_is_inert(tmp_path):
+    assert not NULL_HUB.enabled
+    with NULL_HUB.phase("dispatch"):
+        pass
+    with NULL_HUB.span("x"):
+        pass
+    NULL_HUB.step_completed(8)
+    assert NULL_HUB.snapshot("epoch") == {}
+    NULL_HUB.close()
+    disabled = TelemetryHub(enabled=False, logs_dir=str(tmp_path))
+    disabled.snapshot("epoch")
+    disabled.close()
+    assert os.listdir(tmp_path) == []  # no file ever created
+
+
+def test_hub_step_snapshot_cadence_and_throughput(tmp_path):
+    clock = FakeClock()
+    hub = TelemetryHub(
+        enabled=True, logs_dir=str(tmp_path), snapshot_every_steps=2, clock=clock
+    )
+    for _ in range(5):
+        with hub.phase("dispatch"):
+            clock.advance(0.5)
+        hub.step_completed(episodes=4)
+    hub.close()
+    records = [
+        json.loads(line) for line in open(tmp_path / "telemetry.jsonl")
+    ]
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["step", "step", "final"]  # every 2 steps, 5 % 2 -> final
+    assert records[0]["steps"] == 2 and records[0]["episodes"] == 8
+    # 4 episodes per 0.5s fake-clock step
+    assert records[0]["episodes_per_s"] == pytest.approx(8.0)
+    assert records[-1]["steps"] == 5
+    assert records[-1]["phases"]["dispatch"]["count"] == 5
+    assert os.path.exists(tmp_path / "trace.json")
+
+
+def test_hub_provider_errors_are_contained():
+    hub = TelemetryHub(enabled=True)
+
+    def broken():
+        raise RuntimeError("boom")
+
+    hub.add_provider("ok", lambda: {"x": 1})
+    hub.add_provider("broken", broken)
+    snap = hub.snapshot("epoch")
+    assert snap["providers"]["ok"] == {"x": 1}
+    assert "boom" in snap["providers"]["broken"]["provider_error"]
+
+
+# ---------------------------------------------------------------------------
+# runner e2e
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def toy_dataset(tmp_path_factory):
+    root = tmp_path_factory.mktemp("data") / "omniglot_toy"
+    rng = np.random.RandomState(0)
+    for a in range(4):
+        for c in range(5):
+            d = root / f"alpha{a}" / f"char{c}"
+            d.mkdir(parents=True)
+            base = (rng.rand(28, 28) > 0.5).astype(np.uint8) * 255
+            for i in range(6):
+                noisy = base ^ (rng.rand(28, 28) > 0.95).astype(np.uint8) * 255
+                Image.fromarray(noisy, mode="L").convert("1").save(d / f"{i}.png")
+    return str(root)
+
+
+def _toy_config(toy_dataset, tmp_path, name, **overrides):
+    base = dict(
+        dataset=DatasetConfig(name="omniglot_toy", path=toy_dataset),
+        num_classes_per_set=3,
+        num_samples_per_class=2,
+        num_target_samples=2,
+        batch_size=2,
+        parallel=ParallelConfig(dp=2),
+        total_epochs=2,
+        total_iter_per_epoch=3,
+        num_evaluation_tasks=4,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        experiment_root=str(tmp_path),
+        experiment_name=name,
+        load_into_memory=True,
+        num_dataprovider_workers=2,
+        train_val_test_split=(0.6, 0.2, 0.2),
+        conv_via_patches=True,  # the dp-sharded native-conv GSPMD crash dodge
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def _toy_system(cfg):
+    return MAMLSystem(
+        cfg,
+        model=build_vgg(
+            (28, 28, 1), cfg.num_classes_per_set, num_stages=2,
+            cnn_num_filters=4, conv_via_patches=True,
+        ),
+    )
+
+
+RUNNER_PHASES = ("data_wait", "dispatch", "settle", "eval", "checkpoint")
+
+
+def test_runner_e2e_all_five_phases_with_plausible_durations(
+    toy_dataset, tmp_path
+):
+    cfg = _toy_config(
+        toy_dataset, tmp_path, "obs_e2e",
+        observability=ObservabilityConfig(snapshot_every_steps=2),
+    )
+    runner = ExperimentRunner(cfg, system=_toy_system(cfg))
+    runner.run_experiment()
+    logs = os.path.join(runner.run_dir, "logs")
+
+    records = [json.loads(line) for line in open(os.path.join(logs, "telemetry.jsonl"))]
+    kinds = [r["kind"] for r in records]
+    assert "epoch" in kinds and "step" in kinds and kinds[-1] == "final"
+    epoch_snaps = [r for r in records if r["kind"] == "epoch"]
+    assert [r["epoch"] for r in epoch_snaps] == [0, 1]
+
+    last = records[-1]
+    phases = last["phases"]
+    assert set(RUNNER_PHASES) <= set(phases), sorted(phases)
+    for name in RUNNER_PHASES:
+        s = phases[name]
+        assert s["count"] > 0
+        assert 0.0 <= s["p50_ms"] <= s["max_ms"]
+        assert s["sum_ms"] <= last["elapsed_s"] * 1e3  # no phase exceeds the run
+    # 2 epochs x 3 iters, each dispatched and (guard on) settled exactly once
+    assert phases["dispatch"]["count"] == 6
+    assert phases["settle"]["count"] == 6
+    assert last["steps"] == 6
+    assert last["episodes"] == 6 * cfg.batch_size
+    # train-loop phases cover the epoch wall-clock (the obs_report honesty
+    # check; generous lower bound for a 1-core CI box)
+    train_wall = sum(r["train_wall_s"] for r in epoch_snaps)
+    loop_sum = sum(phases[p]["sum_ms"] / 1e3 for p in ("data_wait", "dispatch", "settle"))
+    assert loop_sum <= train_wall * 1.10
+    assert loop_sum >= train_wall * 0.5
+    # providers rode along
+    assert last["providers"]["loader"]["train_episodes_produced"] == 12
+    assert "watchdog_beat_age_s" in last["providers"]
+
+    # exported trace loads, validates, and carries every runner phase
+    from howtotrainyourmamlpytorch_tpu.observability import load_and_validate_trace
+
+    trace_path = os.path.join(logs, "trace.json")
+    assert load_and_validate_trace(trace_path) == []
+    with open(trace_path) as f:
+        names = {e["name"] for e in json.load(f)["traceEvents"] if e["ph"] == "X"}
+    assert set(RUNNER_PHASES) <= names
+    # the first dispatch (the compile) is tagged cold
+    with open(trace_path) as f:
+        dispatches = [
+            e for e in json.load(f)["traceEvents"]
+            if e.get("name") == "dispatch"
+        ]
+    cold = [e for e in dispatches if (e.get("args") or {}).get("cold")]
+    assert len(cold) >= 1
+
+    # obs_report runs over the fresh dir (human + oneline + json contract)
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo_root, "scripts", "obs_report.py"),
+         runner.run_dir, "--oneline"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    line = json.loads(proc.stdout)
+    assert line["report"] == "obs"
+    assert line["epochs"] == 2
+    assert 0.9 <= line["phase_coverage"] <= 1.1, line
+
+
+def test_observability_off_is_bit_identical_and_fileless(toy_dataset, tmp_path):
+    """The off switch: identical final losses with the subsystem disabled vs
+    enabled (same seeds, same stream), and zero observability artifacts."""
+    results = {}
+    for label, obs in (
+        ("on", ObservabilityConfig(enabled=True)),
+        ("off", ObservabilityConfig(enabled=False)),
+    ):
+        cfg = _toy_config(
+            toy_dataset, tmp_path, f"obs_bitident_{label}", observability=obs
+        )
+        runner = ExperimentRunner(cfg, system=_toy_system(cfg))
+        runner.run_experiment()
+        logs = os.path.join(runner.run_dir, "logs")
+        rows = load_statistics(logs)
+        results[label] = [
+            (r["train_loss_mean"], r["val_loss_mean"], r["train_accuracy_mean"])
+            for r in rows
+        ]
+        has_tel = os.path.exists(os.path.join(logs, "telemetry.jsonl"))
+        has_trace = os.path.exists(os.path.join(logs, "trace.json"))
+        assert has_tel == (label == "on")
+        assert has_trace == (label == "on")
+    # bit-identical: the CSV strings themselves match, not just approx
+    assert results["on"] == results["off"]
+
+
+def test_runner_disabled_hub_multi_dispatch_path(toy_dataset, tmp_path):
+    """The K>1 chunked-dispatch loop runs through the same hub hooks; with
+    observability off it must stay inert there too."""
+    cfg = _toy_config(
+        toy_dataset, tmp_path, "obs_off_multi",
+        train_steps_per_dispatch=3,
+        observability=ObservabilityConfig(enabled=False),
+    )
+    runner = ExperimentRunner(cfg, system=_toy_system(cfg))
+    result = runner.run_experiment()
+    assert "test_accuracy_mean" in result
+    assert not os.path.exists(os.path.join(runner.run_dir, "logs", "telemetry.jsonl"))
