@@ -379,6 +379,11 @@ class Config:
                 f"train_steps_per_dispatch must be >= 1, "
                 f"got {self.train_steps_per_dispatch}"
             )
+        if self.checkpoint_shards < 0:
+            raise ValueError(
+                f"checkpoint_shards must be >= 0 (0 = auto), "
+                f"got {self.checkpoint_shards}"
+            )
         if self.parallel.tp_convs and not self.conv_via_patches:
             # tp_convs is meaningless (and partitioner-fatal) on the native
             # conv path; the patches-GEMM form is a strict requirement, so
@@ -527,6 +532,26 @@ class Config:
     # parallel.tp_convs — see models/layers.py conv2d ``via_patches`` — and
     # auto-enabled by it; usable standalone for A/B perf or numerics probes.
     conv_via_patches: bool = False
+    # --- elastic recovery (ISSUE 6; experiment/runner.py + checkpoint.py) ---
+    # Async checkpointing: epoch saves run on a background writer thread
+    # with a one-save lag (the runner blocks only on the PREVIOUS save at
+    # the next save point), so serialization never sits on the step path.
+    # Auto-disabled when donate_train_state is on (donation invalidates the
+    # buffers a lagged writer would still be reading).
+    checkpoint_async: bool = True
+    # Checkpoint format-3 sharding: split each epoch checkpoint across N
+    # per-shard files + a checksummed manifest (the commit point), so dp x mp
+    # saves stop funneling through one host-side blob. 0 = auto (one shard
+    # per mesh device, i.e. dp*mp; single-device runs keep the format-2
+    # blob); 1 = force single-blob; N>=2 = force N shards.
+    checkpoint_shards: int = 0
+    # Mesh grow-back: when the run is on a degraded mesh (device loss,
+    # resume on a shrunken slice), probe the visible device count at every
+    # epoch boundary and grow the mesh back toward the requested dp x mp as
+    # devices return — resharding the live TrainState up, the inverse of
+    # degraded_mesh_plan (parallel/mesh.py::grow_mesh_plan). Costs one
+    # device-count probe per epoch while degraded; nothing when healthy.
+    elastic_grow: bool = True
     # Early divergence abort (sweep-time guard; 0.0 disables): exit with
     # code 3 when train accuracy is still below this after
     # ``early_abort_epoch`` epochs — a collapsing run (the on-chip 20-way

@@ -253,11 +253,21 @@ class MAMLSystem:
             cfg.total_iter_per_epoch,
         )
         self.outer_opt = optax.adam(learning_rate=self.schedule)
+        self.drop_compiled_programs()
+
+    def drop_compiled_programs(self) -> None:
+        """Forget every compiled train/eval program so the next dispatch of
+        each variant re-traces — the deliberate-invalidation half of the
+        program cache, shared by the rollback LR backoff (the schedule
+        changed) and the elastic mesh grow-back (the sharding changed:
+        programs compiled for the degraded mesh would silently re-place
+        inputs onto it). Strict mode re-plans the same family — recompiles
+        after a deliberate drop are not violations."""
         self._train_step_cache.clear()
         self._train_multi_cache.clear()
         if self.recompile_guard is not None:
             # a deliberate cache drop re-plans the same family: the variants
-            # recompiled against the new schedule are not violations
+            # recompiled against the new programs are not violations
             self.recompile_guard.reset()
         self._note_program(("eval",))  # re-jitted below: count the lowering
         self._eval_step = jax.jit(self._eval_step_impl)

@@ -18,12 +18,24 @@ write, bit rot, truncation — or an injected ``checkpoint.read`` fault) raises
 :func:`load_latest_with_fallback` walks latest -> newest valid epoch so a
 corrupt ``train_model_latest`` degrades a resume by one epoch instead of
 crashing it. Pre-format-2 files (no digest) still load, unverified.
+
+Format 3 (elastic-recovery subsystem): a *sharded* checkpoint — the state's
+leaves split across ``N`` per-shard files plus a checksummed manifest under
+the checkpoint's own name, so a dp x mp save stops funneling through one
+host-side blob. The manifest (format-2-style digest-wrapped, carrying each
+shard file's sha256) is the COMMIT POINT: it is renamed into place only
+after every shard landed, so a kill mid-save leaves invisible stray shards,
+never a loadable-but-torn checkpoint. All three formats load through the
+same ``load_checkpoint`` / fallback chain, and :class:`AsyncCheckpointWriter`
+moves the whole save (device fetch included) onto a background thread with a
+one-save lag, mirroring the runner's one-dispatch-lag pipeline.
 """
 
 import hashlib
 import os
 import re
-from typing import Any, Dict, NamedTuple, Optional, Tuple
+import threading
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -35,6 +47,7 @@ from ..resilience.faults import NULL_INJECTOR
 MODEL_NAME = "train_model"
 
 CHECKPOINT_FORMAT = 2  # 1 (implicit): bare payload; 2: sha256-wrapped body
+SHARDED_FORMAT = 3  # per-shard leaf files + digest-wrapped manifest
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -108,14 +121,168 @@ def _read_payload(path: str, injector=NULL_INJECTOR) -> Tuple[Dict[str, Any], by
         # (and their forensic tooling, scripts/checkpoint_autopsy.py) keep
         # loading
         payload = outer
-    if not isinstance(payload, dict) or "network" not in payload:
-        raise CheckpointCorruptError(f"{path}: payload missing 'network'")
+    if not isinstance(payload, dict) or (
+        "network" not in payload and "shards" not in payload
+    ):
+        raise CheckpointCorruptError(
+            f"{path}: payload missing 'network' (blob) or 'shards' (manifest)"
+        )
     return payload, blob
+
+
+# ---------------------------------------------------------------------------
+# format 3: sharded checkpoints (per-shard leaf files + manifest commit point)
+# ---------------------------------------------------------------------------
+
+
+#: reserved leaf name marking a structurally-present-but-empty subtree (a
+#: BN-free model's ``bn_state``): without it the flatten/unflatten cycle
+#: would drop the empty dict and the restore would fail its structure check
+_EMPTY_MARK = "__empty_dict__"
+
+
+def _flatten_state_dict(nested, prefix: str = "") -> Dict[str, Any]:
+    """``serialization.to_state_dict`` output (pure nested string-keyed
+    dicts of ndarrays) -> flat ``{'params/conv0/w': ndarray}``. The key
+    grammar is stable across processes because the state dict's keys are
+    field/layer names and stringified tuple indices."""
+    flat: Dict[str, Any] = {}
+    for key, value in nested.items():
+        name = f"{prefix}/{key}" if prefix else str(key)
+        if isinstance(value, dict):
+            if value:
+                flat.update(_flatten_state_dict(value, name))
+            else:
+                flat[f"{name}/{_EMPTY_MARK}"] = np.zeros(0, np.uint8)
+        else:
+            flat[name] = value
+    return flat
+
+
+def _unflatten_state_dict(flat: Dict[str, Any]) -> Dict[str, Any]:
+    nested: Dict[str, Any] = {}
+    for key, value in flat.items():
+        node = nested
+        parts = key.split("/")
+        if parts[-1] == _EMPTY_MARK:
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            continue
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return nested
+
+
+def _partition_keys(flat: Dict[str, Any], num_shards: int) -> List[List[str]]:
+    """Greedy byte-balanced partition of the leaf keys into ``num_shards``
+    groups (largest leaf first into the lightest bin) so shard files come
+    out near-equal — the point of sharding is that no single file carries
+    the whole state. Deterministic: ties break on key order."""
+    sized = sorted(
+        flat.items(),
+        key=lambda kv: (-int(getattr(kv[1], "nbytes", 0) or 0), kv[0]),
+    )
+    bins: List[List[str]] = [[] for _ in range(num_shards)]
+    weights = [0] * num_shards
+    for key, value in sized:
+        i = weights.index(min(weights))
+        bins[i].append(key)
+        weights[i] += int(getattr(value, "nbytes", 0) or 0) + 1
+    return bins
+
+
+def _shard_path(path: str, k: int) -> str:
+    return f"{path}.shard{k}"
+
+
+def _shard_files(path: str) -> List[str]:
+    """LIVE shard files of one checkpoint path — exactly ``<path>.shard<N>``.
+    Quarantined forensics (``.shardN.corrupt``) and stray write temps must
+    never match: the stale-shard sweep and rotation delete what this
+    returns, and a second quarantine renames it."""
+    pattern = re.compile(re.escape(os.path.basename(path)) + r"\.shard\d+$")
+    parent = os.path.dirname(path) or "."
+    if not os.path.isdir(parent):
+        return []
+    return sorted(
+        os.path.join(parent, name)
+        for name in os.listdir(parent)
+        if pattern.fullmatch(name)
+    )
+
+
+def _sharded_serialize(
+    state: TrainState, num_shards: int
+) -> Tuple[List[bytes], Dict[str, Any]]:
+    """-> (shard blobs, manifest body dict minus bookkeeping). Each shard is
+    a msgpack map of flat-key -> ndarray; the manifest records each shard's
+    sha256 of the FINAL file bytes, so the manifest's own digest transitively
+    covers the whole checkpoint."""
+    flat = _flatten_state_dict(
+        serialization.to_state_dict(jax.tree.map(np.asarray, state))
+    )
+    blobs = [
+        serialization.msgpack_serialize({key: flat[key] for key in keys})
+        for keys in _partition_keys(flat, num_shards)
+    ]
+    return blobs, {"num_leaves": len(flat)}
+
+
+def _read_shards(path: str, payload: Dict[str, Any], injector=NULL_INJECTOR) -> Dict[str, Any]:
+    """Read + digest-verify every shard a manifest names -> merged flat leaf
+    dict. Any missing/corrupt shard fails the WHOLE checkpoint (the manifest
+    is all-or-nothing), with the same error class as a torn blob so the
+    fallback chain quarantines and walks on."""
+    save_dir = os.path.dirname(path)
+    flat: Dict[str, Any] = {}
+    for entry in payload["shards"]:
+        shard_path = os.path.join(save_dir, entry["file"])
+        try:
+            with open(shard_path, "rb") as f:
+                blob = f.read()
+        except OSError as exc:
+            raise CheckpointCorruptError(
+                f"{path}: missing shard {entry['file']} ({exc!r})"
+            ) from exc
+        blob = injector.fire_bytes("checkpoint.read", blob)
+        digest = hashlib.sha256(blob).hexdigest()
+        if digest != entry["sha256"]:
+            raise CheckpointCorruptError(
+                f"{path}: shard {entry['file']} sha256 mismatch (stored "
+                f"{entry['sha256'][:12]}…, computed {digest[:12]}…)"
+            )
+        try:
+            flat.update(serialization.msgpack_restore(blob))
+        except Exception as exc:
+            raise CheckpointCorruptError(
+                f"{path}: undecodable shard {entry['file']} ({exc!r})"
+            ) from exc
+    if len(flat) != payload.get("num_leaves", len(flat)):
+        raise CheckpointCorruptError(
+            f"{path}: manifest promises {payload.get('num_leaves')} leaves, "
+            f"shards hold {len(flat)}"
+        )
+    return flat
+
+
+def _restore_network(payload: Dict[str, Any], path: str, template, injector=NULL_INJECTOR):
+    """Format dispatch for the state restore: blob formats hand flax the
+    serialized bytes; format 3 reassembles the state dict from shards."""
+    if "shards" in payload:
+        nested = _unflatten_state_dict(_read_shards(path, payload, injector))
+        return serialization.from_state_dict(template, nested)
+    return serialization.from_bytes(template, payload["network"])
 
 
 def _write_atomic(target: str, blob: bytes, injector=NULL_INJECTOR) -> None:
     blob = injector.fire_bytes("checkpoint.write", blob)
-    tmp = target + ".tmp"
+    # unique temp per (thread, call): the async epoch writer and the wedge
+    # watchdog's emergency save can both target train_model_latest at the
+    # same instant — a shared fixed '.tmp' would let one thread rename the
+    # other's half-written temp into place. With unique temps every rename
+    # moves a COMPLETE file; last-rename-wins is then always loadable.
+    tmp = f"{target}.tmp-{os.getpid()}-{threading.get_ident()}"
     with open(tmp, "wb") as f:
         f.write(blob)
     os.replace(tmp, target)  # atomic: preemption-safe (SURVEY.md §5.3)
@@ -123,11 +290,16 @@ def _write_atomic(target: str, blob: bytes, injector=NULL_INJECTOR) -> None:
 
 def quarantine(save_dir: str, idx) -> Optional[str]:
     """Rename a corrupt checkpoint to ``*.corrupt`` (kept for forensics,
-    invisible to ``available_epochs``/``checkpoint_exists``). Returns the new
+    invisible to ``available_epochs``/``checkpoint_exists``). A format-3
+    checkpoint quarantines its shard files alongside the manifest — the
+    manifest names them, so leaving them behind would strand orphan shards a
+    later same-idx save could partially overwrite. Returns the new manifest
     path, or None if the file was already gone."""
     path = _path(save_dir, idx)
     if not os.path.exists(path):
         return None
+    for shard in _shard_files(path):
+        os.replace(shard, shard + ".corrupt")
     target = path + ".corrupt"
     os.replace(path, target)
     return target
@@ -143,6 +315,81 @@ def save_named(
     return path
 
 
+def _manifest_blob(
+    shard_entries: List[Dict[str, Any]], bookkeeping: Dict[str, Any], num_leaves: int
+) -> bytes:
+    body = serialization.msgpack_serialize(
+        {
+            "shards": shard_entries,
+            "num_leaves": num_leaves,
+            "bookkeeping": bookkeeping,
+        }
+    )
+    return serialization.msgpack_serialize(
+        {
+            "format": SHARDED_FORMAT,
+            "sha256": hashlib.sha256(body).hexdigest(),
+            "body": body,
+        }
+    )
+
+
+def _save_sharded(
+    save_dir: str,
+    state: TrainState,
+    bookkeeping: Dict[str, Any],
+    epoch: int,
+    num_shards: int,
+    injector=NULL_INJECTOR,
+) -> str:
+    """Format-3 save: shards first (atomic each), manifest last — the
+    manifest rename is the commit point, so a kill at ANY instant leaves
+    either the previous complete checkpoint or the new complete one, never a
+    readable half. ``latest`` reuses the epoch's shard bytes via hardlinks
+    (same content, no second serialization pass); its manifest names the
+    latest-prefixed links, so epoch-file rotation can never strand it."""
+    path = _path(save_dir, epoch)
+    latest = _path(save_dir, "latest")
+    blobs, extra = _sharded_serialize(state, num_shards)
+    entries, latest_entries = [], []
+    for k, blob in enumerate(blobs):
+        shard = _shard_path(path, k)
+        _write_atomic(shard, blob, injector)
+        # the digest is of the bytes as WRITTEN (injector included): an
+        # injected torn write must be detected at load, exactly like rot
+        with open(shard, "rb") as f:
+            written = f.read()
+        digest = hashlib.sha256(written).hexdigest()
+        entries.append({"file": os.path.basename(shard), "sha256": digest})
+        link = _shard_path(latest, k)
+        tmp = f"{link}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            os.link(shard, tmp)
+        except OSError:  # cross-device / no-hardlink filesystem: plain copy
+            with open(tmp, "wb") as f:
+                f.write(written)
+        os.replace(tmp, link)
+        latest_entries.append({"file": os.path.basename(link), "sha256": digest})
+    _write_atomic(
+        path, _manifest_blob(entries, bookkeeping, extra["num_leaves"]), injector
+    )
+    _write_atomic(
+        latest,
+        _manifest_blob(latest_entries, bookkeeping, extra["num_leaves"]),
+        injector,
+    )
+    # a previous save under the same idx with MORE shards leaves stale
+    # higher-index files the fresh manifests no longer name — sweep them
+    # once both manifests are committed
+    named = {os.path.basename(_shard_path(p, k)) for p in (path, latest)
+             for k in range(len(blobs))}
+    for target in (path, latest):
+        for stale in _shard_files(target):
+            if os.path.basename(stale) not in named:
+                os.remove(stale)
+    return path
+
+
 def save_checkpoint(
     save_dir: str,
     state: TrainState,
@@ -151,17 +398,23 @@ def save_checkpoint(
     max_models_to_save: int = 5,
     val_acc_by_epoch: Optional[Dict[int, float]] = None,
     injector=NULL_INJECTOR,
+    num_shards: int = 1,
 ) -> str:
     """Write ``train_model_{epoch}`` + ``train_model_latest`` and rotate.
 
-    Rotation keeps ``max_models_to_save`` per-epoch files: the most recent
-    ones by default, or — when ``val_acc_by_epoch`` is given — the top ones by
-    validation accuracy (upstream MAML++ kept its best-5 val models for test
-    ensembling; SURVEY.md §2.9 item 4)."""
-    blob = _serialize(state, bookkeeping)
-    path = _path(save_dir, epoch)
-    for target in (path, _path(save_dir, "latest")):
-        _write_atomic(target, blob, injector)
+    ``num_shards >= 2`` writes checkpoint format 3 (per-shard files + a
+    manifest commit point — see :func:`_save_sharded`); 1 keeps the
+    single-blob format 2. Rotation keeps ``max_models_to_save`` per-epoch
+    files: the most recent ones by default, or — when ``val_acc_by_epoch``
+    is given — the top ones by validation accuracy (upstream MAML++ kept its
+    best-5 val models for test ensembling; SURVEY.md §2.9 item 4)."""
+    if num_shards >= 2:
+        path = _save_sharded(save_dir, state, bookkeeping, epoch, num_shards, injector)
+    else:
+        blob = _serialize(state, bookkeeping)
+        path = _path(save_dir, epoch)
+        for target in (path, _path(save_dir, "latest")):
+            _write_atomic(target, blob, injector)
     _rotate(save_dir, max_models_to_save, val_acc_by_epoch)
     return path
 
@@ -175,7 +428,12 @@ def _rotate(save_dir: str, keep: int, val_acc_by_epoch: Optional[Dict[int, float
         # from an older run) rank lowest, ties broken oldest-first
         epochs = sorted(epochs, key=lambda e: (val_acc_by_epoch.get(e, -1.0), e))
     for epoch in epochs[:-keep]:
-        os.remove(_path(save_dir, epoch))
+        path = _path(save_dir, epoch)
+        # a format-3 epoch's shards go with its manifest ('latest' holds its
+        # own hardlinked copies, so this never strands the resume chain)
+        for shard in _shard_files(path):
+            os.remove(shard)
+        os.remove(path)
 
 
 def load_checkpoint(
@@ -183,11 +441,13 @@ def load_checkpoint(
 ) -> Tuple[TrainState, Dict[str, Any]]:
     """``idx`` is an epoch number or 'latest' (reference load_model API,
     ``few_shot_learning_system.py:419-432``). ``template_state`` supplies the
-    pytree structure (an ``init_train_state()`` result). Digest-verified:
-    raises :class:`CheckpointCorruptError` on a bad file."""
-    payload, _ = _read_payload(_path(save_dir, idx), injector)
+    pytree structure (an ``init_train_state()`` result). Digest-verified
+    (manifest AND every shard for format 3): raises
+    :class:`CheckpointCorruptError` on a bad file."""
+    path = _path(save_dir, idx)
+    payload, _ = _read_payload(path, injector)
     template = jax.tree.map(np.asarray, template_state)
-    state = serialization.from_bytes(template, payload["network"])
+    state = _restore_network(payload, path, template, injector)
     return TrainState(*state), payload["bookkeeping"]
 
 
@@ -234,11 +494,17 @@ def load_for_inference(
 
     Unlike :func:`load_checkpoint` this needs no template state: the flax
     msgpack payload stores the TrainState by field name with plain
-    dict-of-ndarray subtrees, which restore structurally as-is."""
-    payload, blob = _read_payload(_path(save_dir, idx), injector)
-    # "network" is itself msgpack bytes (see _serialize): decode the inner
-    # layer to the field-name-keyed TrainState dict
-    net = serialization.msgpack_restore(payload["network"])
+    dict-of-ndarray subtrees, which restore structurally as-is. A format-3
+    fingerprint hashes the manifest blob — it embeds every shard's digest,
+    so it is content-addressed transitively, exactly like the blob hash."""
+    path = _path(save_dir, idx)
+    payload, blob = _read_payload(path, injector)
+    if "shards" in payload:
+        net = _unflatten_state_dict(_read_shards(path, payload, injector))
+    else:
+        # "network" is itself msgpack bytes (see _serialize): decode the
+        # inner layer to the field-name-keyed TrainState dict
+        net = serialization.msgpack_restore(payload["network"])
     state = InferenceState(
         params=net["params"],
         bn_state=net["bn_state"],
@@ -247,6 +513,63 @@ def load_for_inference(
         fingerprint=hashlib.sha256(blob).hexdigest(),
     )
     return state, payload["bookkeeping"]
+
+
+class AsyncCheckpointWriter:
+    """One-save-lag background checkpoint writer.
+
+    The runner's step loop must never block on checkpoint serialization —
+    the save (device fetch, msgpack, shard writes) runs on a background
+    thread, and the caller blocks only on the *previous* save at the next
+    save point, mirroring the one-dispatch-lag device pipeline. jax arrays
+    are immutable, so the background thread can ``device_get`` a state the
+    main thread has long since stepped past (donation — which invalidates
+    buffers — is the documented exception; the runner keeps async saves off
+    when ``donate_train_state`` is on).
+
+    A failed save re-raises on the next :meth:`wait`/:meth:`submit`/
+    :meth:`close`, so a dead disk surfaces one save late, never silently.
+    At most one save is in flight; the writes themselves stay atomic
+    (tmp+rename; format-3 manifest as the commit point), so killing the
+    process mid-save can never leave a loadable-but-torn checkpoint."""
+
+    def __init__(self, name: str = "ckpt-writer"):
+        self._name = name
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        """Block on the previous save (the one-save lag), then start ``fn``
+        on the writer thread."""
+        self.wait()
+
+        def run():
+            try:
+                fn()
+            except BaseException as exc:  # noqa: BLE001 — carried to wait()
+                self._error = exc
+
+        self._thread = threading.Thread(target=run, name=self._name, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        """Join the in-flight save, if any; re-raise its failure."""
+        thread = self._thread
+        if thread is not None:
+            thread.join()
+            self._thread = None
+        if self._error is not None:
+            error = self._error
+            self._error = None
+            raise error
+
+    @property
+    def busy(self) -> bool:
+        thread = self._thread
+        return thread is not None and thread.is_alive()
+
+    def close(self) -> None:
+        self.wait()
 
 
 def latest_checkpoint_exists(save_dir: str) -> bool:

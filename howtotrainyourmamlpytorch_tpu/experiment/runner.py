@@ -36,6 +36,7 @@ from ..parallel import (
     chunk_sharding,
     degraded_mesh_plan,
     global_batch_from_local,
+    grow_mesh_plan,
     make_mesh,
     requested_mesh_shape,
     shard_train_state,
@@ -80,8 +81,14 @@ class ExperimentRunner:
         system: Optional[MAMLSystem] = None,
         loader: Optional[MetaLearningDataLoader] = None,
         data_root: Optional[str] = None,
+        device_probe=None,
     ):
         self.cfg = cfg
+        # the cheap visible-device probe used at init (degraded-mesh plan)
+        # and at epoch boundaries while degraded (grow-back plan);
+        # injectable so elasticity drills can walk a device count up and
+        # down inside one process
+        self._device_probe = device_probe or (lambda: len(jax.devices()))
         self.system = system or MAMLSystem(cfg)
         self.run_dir = cfg.run_dir()
         self.saved_models_dir, self.logs_dir, self.visual_dir = storage.build_experiment_folder(
@@ -126,6 +133,10 @@ class ExperimentRunner:
         # test ensembling (persisted in checkpoint bookkeeping)
         self.val_acc_by_epoch: Dict[int, float] = {}
         self._profiled = False
+        # the (dp, mp) the resumed checkpoint was written under (bookkeeping
+        # "mesh" key, absent on pre-elastic checkpoints): growing past it on
+        # resume is a mesh_grown event, the inverse of degraded_mesh
+        self._resume_prev_mesh = None
         idx = cfg.continue_from_epoch
         resumable = idx not in ("", "scratch", None)
         if resumable and not ckpt.checkpoint_exists(self.saved_models_dir, idx):
@@ -163,6 +174,9 @@ class ExperimentRunner:
                 int(k): float(v)
                 for k, v in (bookkeeping.get("val_acc_by_epoch") or {}).items()
             }
+            prev_mesh = bookkeeping.get("mesh")
+            if prev_mesh is not None:
+                self._resume_prev_mesh = [int(x) for x in prev_mesh]
             storage.change_json_log_experiment_status(
                 self.logs_dir, self.experiment_name,
                 f"resumed at epoch {self.start_epoch}"
@@ -177,6 +191,7 @@ class ExperimentRunner:
             flush=True,
         )
         global_batch_size = cfg.batch_size * cfg.samples_per_iter
+        self._global_batch_size = global_batch_size
         self.mesh = None
         # elastic degraded resume: fewer visible devices than ParallelConfig
         # demands (a chip died, a slice shrank across a maintenance event)
@@ -185,7 +200,7 @@ class ExperimentRunner:
         # throughput — a lost device costs bandwidth, not the run.
         self.degraded_mesh: Optional[Dict[str, Any]] = None
         parallel = cfg.parallel
-        n_visible = len(jax.devices())
+        n_visible = int(self._device_probe())
         if parallel.shard_meta_batch:
             plan = degraded_mesh_plan(parallel, n_visible, global_batch_size)
             if plan is not None:
@@ -240,6 +255,32 @@ class ExperimentRunner:
             self._batch_sharding = batch_sharding(self.mesh)
             self._chunk_sharding = chunk_sharding(self.mesh)
 
+        # resume-side mesh grow-back (the inverse of the degraded event
+        # above): the checkpoint was written under a smaller mesh than this
+        # process just built — devices came back between runs, the restore
+        # already resharded the state UP onto the bigger mesh, log it
+        granted_now = self._mesh_shape()
+        if (
+            self._resume_prev_mesh is not None
+            and granted_now[0] * granted_now[1]
+            > self._resume_prev_mesh[0] * self._resume_prev_mesh[1]
+        ):
+            self._note_mesh_grown(
+                previous=self._resume_prev_mesh,
+                granted=granted_now,
+                n_visible=n_visible,
+            )
+
+        # async one-save-lag checkpoint writer (experiment/checkpoint.py):
+        # epoch serialization runs off the step path. Donation invalidates
+        # the buffers a lagged background device_get would read — keep the
+        # save synchronous there.
+        self._ckpt_writer: Optional[ckpt.AsyncCheckpointWriter] = (
+            ckpt.AsyncCheckpointWriter()
+            if cfg.checkpoint_async and not cfg.donate_train_state
+            else None
+        )
+
         # multi-host SPMD: each host materializes only its slice of the global
         # meta-batch; _put stitches the global sharded arrays (SURVEY.md §5.8).
         # Host-sharding without a mesh would mean every host silently training
@@ -288,6 +329,7 @@ class ExperimentRunner:
             {
                 "epoch": self.start_epoch - 1,
                 "mid_epoch_iter": self._resume_mid_iter,
+                "mesh": self._mesh_shape(),
                 "train_episodes_produced": self.loader.train_episodes_produced,
                 "best_val_accuracy": self.best_val_accuracy,
                 "best_val_epoch": self.best_val_epoch,
@@ -551,6 +593,24 @@ class ExperimentRunner:
         if self._watchdog is not None:
             self._watchdog.beat(stage)
 
+    def _drain_ckpt_writer(self) -> None:
+        """Block until any in-flight async save lands; a failed save is
+        reported (events + stderr) but never masks the caller's own exit
+        path — the run already has newer state than the failed file."""
+        if self._ckpt_writer is None:
+            return
+        try:
+            self._ckpt_writer.wait()
+        except Exception as exc:  # noqa: BLE001 — surfaced, not fatal here
+            print(f"warning: async checkpoint save failed: {exc!r}", flush=True)
+            try:
+                self.events.append(
+                    {"ts": time.time(), "event": "checkpoint_save_failed",
+                     "error": repr(exc)}
+                )
+            except Exception:
+                pass
+
     def _on_wedge(self, info: Dict[str, Any]) -> None:
         """Watchdog verdict: zero progress past the deadline — the main
         thread is hung in an uninterruptible device call. Runs ON THE
@@ -581,6 +641,12 @@ class ExperimentRunner:
             )
         except Exception:
             pass
+        # deliberately NOT draining the async writer here: its device_get
+        # may itself be hung on the wedged device, and waiting would block
+        # the exit forever. Writes stay safe regardless — per-thread unique
+        # temp files (+ atomic renames) mean an in-flight epoch save and
+        # this emergency save can interleave on 'latest' and the survivor
+        # is always a complete, loadable checkpoint (last rename wins).
         try:
             anchor_state, anchor_book = self._wedge_anchor  # one atomic read
             ckpt.save_named(
@@ -627,6 +693,100 @@ class ExperimentRunner:
 
     def _capture_last_good(self) -> None:
         self._last_good = jax.device_get(self.state)
+
+    # ------------------------------------------------------------------
+    # elastic mesh grow-back (parallel/mesh.py::grow_mesh_plan)
+    # ------------------------------------------------------------------
+
+    def _mesh_shape(self):
+        """The (dp, mp) actually in use, [1, 1] when meshless."""
+        if self.mesh is None:
+            return [1, 1]
+        return [int(self.mesh.shape["dp"]), int(self.mesh.shape.get("mp", 1))]
+
+    def _checkpoint_shards(self) -> int:
+        """Effective format-3 shard count: the config's explicit value, or
+        (auto, 0) one shard per mesh device so a dp x mp run's save is
+        spread exactly as wide as its state is."""
+        n = self.cfg.checkpoint_shards
+        if n == 0:
+            n = int(self.mesh.size) if self.mesh is not None else 1
+        return max(n, 1)
+
+    def _note_mesh_grown(self, previous, granted, n_visible: int) -> None:
+        dp_req, mp_req = requested_mesh_shape(self.cfg.parallel, n_visible)
+        full = granted == [dp_req, mp_req]
+        info = {
+            "previous": list(previous),
+            "granted": list(granted),
+            "requested": [dp_req, mp_req],
+            "visible_devices": n_visible,
+        }
+        msg = (
+            f"MESH GROWN: dp={previous[0]} x mp={previous[1]} -> "
+            f"dp={granted[0]} x mp={granted[1]} "
+            f"({n_visible} device(s) visible"
+            + ("" if full else f"; config demands dp={dp_req} x mp={mp_req}")
+            + ") — recovered capacity, training continues"
+        )
+        print(msg, flush=True)
+        self.events.append({"ts": time.time(), "event": "mesh_grown", **info})
+        storage.change_json_log_experiment_status(
+            self.logs_dir, self.experiment_name, msg
+        )
+        if full:
+            self.degraded_mesh = None
+        else:
+            self.degraded_mesh = {
+                "requested": [dp_req, mp_req],
+                "granted": list(granted),
+                "visible_devices": n_visible,
+            }
+        if self.hub.enabled:
+            self.hub.registry.set_gauge("degraded_mesh", self.degraded_mesh)
+            self.hub.registry.set_gauge("mesh_grown", info)
+
+    def _maybe_grow_mesh(self) -> bool:
+        """Epoch-boundary grow-back: while degraded, one cheap device-count
+        probe decides whether more devices are visible than the current mesh
+        uses; if the grow plan improves on it, reshard the live TrainState up
+        and drop the compiled programs (they bake the old placements).
+        Nothing runs when the mesh is healthy. Returns True on a grow."""
+        if (
+            self.degraded_mesh is None
+            or not self.cfg.elastic_grow
+            or self._multihost
+            or not self.cfg.parallel.shard_meta_batch
+        ):
+            return False
+        n_visible = int(self._device_probe())
+        current = tuple(self.degraded_mesh["granted"])
+        plan = grow_mesh_plan(
+            self.cfg.parallel, n_visible, self._global_batch_size, current
+        )
+        if plan is None:
+            return False
+        previous = list(current)
+        dp, mp = plan
+        # one host round-trip per grow (rare): fetch the settled state, then
+        # place it with the new mesh's shardings — the same path a degraded
+        # resume takes, just without the process restart
+        host_state = jax.device_get(self.state)
+        parallel = dataclasses.replace(self.cfg.parallel, dp=dp, mp=mp)
+        self.mesh = make_mesh(parallel)
+        self.state = shard_train_state(
+            host_state, self.mesh, tp_convs=self.cfg.parallel.tp_convs
+        )
+        self._batch_sharding = batch_sharding(self.mesh)
+        self._chunk_sharding = chunk_sharding(self.mesh)
+        # programs compiled for the degraded mesh would re-place every input
+        # back onto it — drop them all; strict mode re-plans the same family
+        # (the scale_meta_lr pattern), and the next dispatch of each variant
+        # is cold again
+        self.system.drop_compiled_programs()
+        self._variants_seen.clear()
+        self._note_mesh_grown(previous=previous, granted=[dp, mp], n_visible=n_visible)
+        return True
 
     def _note_bad_step(self, epoch: int) -> None:
         """One discarded non-finite step. The ladder: after
@@ -722,6 +882,10 @@ class ExperimentRunner:
         ``undispatched``: batches already drawn from the loader but never
         dispatched (they will be re-drawn on resume)."""
         cfg = self.cfg
+        # drain any in-flight async epoch save first: the emergency 'latest'
+        # written below must be the FINAL latest, not racing a lagged writer
+        # that would clobber it with an older epoch-boundary state
+        self._drain_ckpt_writer()
         consumed = (
             self.loader.train_episodes_produced // self.loader.batch_size
             - epoch * cfg.total_iter_per_epoch
@@ -736,6 +900,7 @@ class ExperimentRunner:
             "best_val_accuracy": self.best_val_accuracy,
             "best_val_epoch": self.best_val_epoch,
             "val_acc_by_epoch": {str(k): v for k, v in self.val_acc_by_epoch.items()},
+            "mesh": self._mesh_shape(),
         }
         ckpt.save_named(
             self.saved_models_dir,
@@ -832,27 +997,46 @@ class ExperimentRunner:
             "best_val_epoch": self.best_val_epoch,
             "train_episodes_produced": self.loader.train_episodes_produced,
             "val_acc_by_epoch": {str(k): v for k, v in self.val_acc_by_epoch.items()},
+            "mesh": self._mesh_shape(),
         }
-        with self.hub.phase("checkpoint", epoch=epoch):
-            host_state = jax.device_get(self.state)
+        # val_acc_by_epoch mutates across epochs; the writer thread needs
+        # this epoch's snapshot
+        rotation_accs = (
+            dict(self.val_acc_by_epoch)
+            if self.cfg.checkpoint_rotation == "best_val"
+            else None
+        )
+        state, num_shards = self.state, self._checkpoint_shards()
+
+        def write() -> None:
+            # jax arrays are immutable: fetching `state` here is safe even
+            # after the main thread has stepped past it (donation — the one
+            # exception — forces the sync path at writer construction)
+            host_state = jax.device_get(state)
             ckpt.save_checkpoint(
                 self.saved_models_dir,
                 host_state,
                 bookkeeping,
                 epoch,
                 self.cfg.max_models_to_save,
-                val_acc_by_epoch=(
-                    self.val_acc_by_epoch
-                    if self.cfg.checkpoint_rotation == "best_val"
-                    else None
-                ),
+                val_acc_by_epoch=rotation_accs,
                 injector=self._injector,
+                num_shards=num_shards,
             )
-        # this durable state is the new NaN-rollback anchor, and (with its
-        # bookkeeping) the wedge watchdog's emergency-checkpoint anchor
-        self._last_good = host_state
-        self._wedge_anchor = (host_state, {**bookkeeping, "mid_epoch_iter": 0})
-        self._beat(f"checkpoint epoch {epoch}")
+            # this durable state is the new NaN-rollback anchor, and (with
+            # its bookkeeping) the wedge watchdog's emergency-checkpoint
+            # anchor — both single-reference rebinds, safe from this thread
+            self._last_good = host_state
+            self._wedge_anchor = (host_state, {**bookkeeping, "mid_epoch_iter": 0})
+            self._beat(f"checkpoint epoch {epoch}")
+
+        with self.hub.phase("checkpoint", epoch=epoch):
+            if self._ckpt_writer is not None:
+                # one-save lag: block on the PREVIOUS epoch's save (usually
+                # long finished), then get serialization off the step path
+                self._ckpt_writer.submit(write)
+            else:
+                write()
 
     def _save_best(self) -> None:
         ckpt.save_named(
@@ -978,6 +1162,9 @@ class ExperimentRunner:
                         return self._run_experiment()
                 return self._run_experiment()
         finally:
+            # any in-flight async epoch save must land before the process
+            # (or the test harness) reads the run dir as final
+            self._drain_ckpt_writer()
             if self._watchdog is not None:
                 self._watchdog.stop()
             # final telemetry snapshot + Chrome-trace export on every
@@ -999,6 +1186,10 @@ class ExperimentRunner:
 
         end_epoch = min(cfg.total_epochs, self.start_epoch + cfg.total_epochs_before_pause)
         for epoch in range(self.start_epoch, end_epoch):
+            # elastic grow-back: while degraded, one cheap device-count
+            # probe per epoch boundary; devices returned => the live state
+            # is resharded up before this epoch trains (no-op when healthy)
+            self._maybe_grow_mesh()
             stats: Dict[str, Any] = {"epoch": epoch}
             stats.update(self._train_epoch(epoch))
             stats.update(self._eval_split("val"))
@@ -1068,6 +1259,9 @@ class ExperimentRunner:
                     self.logs_dir, self.experiment_name, msg
                 )
                 raise SystemExit(exit_codes.DIVERGED)
+        # settle the last epoch's async save (and its rotation) before the
+        # test phase reads/loads the per-epoch checkpoint files
+        self._drain_ckpt_writer()
         self.load_best()
         test_stats = self.evaluate_test()
         return {

@@ -78,6 +78,32 @@ def degraded_mesh_plan(
     return dp, mp
 
 
+def grow_mesh_plan(
+    parallel: Optional[ParallelConfig],
+    n_devices: int,
+    global_batch_size: int,
+    current,
+):
+    """Grow plan — the inverse of :func:`degraded_mesh_plan`: the run is on a
+    degraded ``current = (dp, mp)`` mesh and more devices are visible again
+    (slice back from maintenance, resume on a healed host). Returns the
+    largest feasible ``(dp, mp)`` — the full requested shape when it fits,
+    else the best degraded shape the visible devices allow — or ``None``
+    when that is no improvement over ``current``. "Improvement" is strictly
+    more devices in use: the plan never trades dp for mp sideways, so a
+    grow-back is always a pure capacity gain and the shrink/grow pair can
+    never oscillate between equal-sized shapes. The math is unchanged in
+    both directions — resharding only re-places the same arrays (see
+    ``degraded_mesh_plan``); the cost of a grow is one re-placement plus the
+    recompiles for the new mesh."""
+    cur_dp, cur_mp = current
+    plan = degraded_mesh_plan(parallel, n_devices, global_batch_size)
+    best = requested_mesh_shape(parallel, n_devices) if plan is None else plan
+    if best[0] * best[1] <= cur_dp * cur_mp:
+        return None
+    return best
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Tasks of the meta-batch sharded over dp; everything else replicated."""
     return NamedSharding(mesh, P(DATA_AXIS))

@@ -20,6 +20,11 @@ under load. This package is the shared machinery that makes both promises
 - :mod:`campaign` — the seeded chaos-soak runner (``scripts/chaos_soak.py``)
   that walks every fault seam through short episodes and checks the
   cross-cutting invariants after each.
+- :mod:`fleet` — the config x seed campaign scheduler
+  (``scripts/fleet_run.py``): subprocess gang-scheduling with the rc policy
+  consumed straight from ``exit_codes.py`` (bounded 75/76 restarts with
+  exact resume, 3 = diverged-move-on, 64/65 = pause on the TPU gate), a
+  stall watchdog, and fleet-level obs aggregation.
 
 Consumers of the *policies* (NaN-step skip/rollback ladder, preemption-safe
 emergency checkpoints, checkpoint integrity + fallback, load shedding) live
@@ -37,6 +42,7 @@ from .faults import (  # noqa: F401
     InjectedFault,
     injector_from,
 )
+from .fleet import FleetCell, FleetScheduler, FleetSpec  # noqa: F401
 from .retry import DeadlineExceededError, backoff_schedule, retry_call  # noqa: F401
 from .watchdog import (  # noqa: F401
     WEDGE_EXIT_CODE,

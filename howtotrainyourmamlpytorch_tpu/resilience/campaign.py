@@ -144,9 +144,10 @@ class Episode:
     (checked against the FAULTED leg; clean resume legs must always exit 0)."""
 
     kind: str
-    mode: str  # train | resume | shrink | serve
+    mode: str  # train | resume | shrink | grow | serve
     faults: List[str] = field(default_factory=list)
     resilience_overrides: Dict[str, Any] = field(default_factory=dict)
+    config_overrides: Dict[str, Any] = field(default_factory=dict)
     expected_rcs: tuple = (0,)
     subprocess: bool = False  # faulted leg needs a fresh interpreter (os._exit)
     resume_after: bool = False  # run a clean resume leg after the faulted one
@@ -214,6 +215,32 @@ def episode_menu(rng: np.random.RandomState) -> List[Episode]:
             subprocess=True,
             resume_devices=1,
             required_events=("degraded_mesh",),
+        ),
+        Episode(
+            # shrink then GROW BACK: full-mesh train, resume on 1 device
+            # (degrades), resume again with every device visible — the mesh
+            # must grow back (state resharded up, mesh_grown event) and
+            # training must continue through the grown epoch
+            kind="device-grow-resume",
+            mode="grow",
+            expected_rcs=(0,),
+            subprocess=True,
+            resume_devices=1,
+            required_events=("degraded_mesh", "mesh_grown"),
+        ),
+        Episode(
+            # SIGTERM landing while an async sharded epoch save is in
+            # flight (the checkpoint.write seam fires between shard files,
+            # on the writer thread): the manifest commit point means no leg
+            # may ever see a loadable-but-torn checkpoint, and the
+            # preemption path must still exit 75 with a resumable latest
+            kind="sigterm-during-async-save",
+            mode="train",
+            faults=[f"checkpoint.write=sigterm:nth={nth(2, 3)}"],
+            config_overrides=dict(checkpoint_async=True, checkpoint_shards=2),
+            expected_rcs=(exit_codes.PREEMPTED,),
+            resume_after=True,
+            required_events=("preempted",),
         ),
         Episode(kind="serve-dispatch-raise", mode="serve"),
         Episode(kind="serve-dispatch-hang", mode="serve"),
@@ -311,7 +338,13 @@ def _check_checkpoints(run_dir: str, template_state) -> Optional[str]:
 
     save_dir = os.path.join(run_dir, "saved_models")
     has_any = os.path.isdir(save_dir) and any(
-        name.startswith(ckpt.MODEL_NAME) and not name.endswith(".corrupt")
+        name.startswith(ckpt.MODEL_NAME)
+        and not name.endswith(".corrupt")
+        # stray format-3 shard files without their manifest and write temps
+        # (a kill before the commit point) are invisible garbage, not a
+        # checkpoint — only a manifest/blob name counts as "one exists"
+        and ".shard" not in name
+        and ".tmp" not in name
         for name in os.listdir(save_dir)
     )
     if not has_any:
@@ -574,7 +607,7 @@ def run_campaign(
                 log(f"chaos: skipping {ep.kind} off the main thread")
                 results.append({"kind": ep.kind, "skipped": True})
                 continue
-            base = campaign_config(data_root, exp_root, name)
+            base = campaign_config(data_root, exp_root, name, **ep.config_overrides)
             faulted = dataclasses.replace(
                 base,
                 resilience=dataclasses.replace(
@@ -635,6 +668,22 @@ def run_campaign(
                     n_devices=ep.resume_devices,
                 )
                 rcs.append(fault_rc)
+            elif ep.mode == "grow":
+                # shrink leg first (as above), then resume with every
+                # device visible again: the grow-back path reshards the
+                # state up, logs mesh_grown, and trains the extra epoch
+                rcs.append(_run(base, False))
+                rcs.append(
+                    _run(
+                        dataclasses.replace(base, total_epochs=3),
+                        True,
+                        n_devices=ep.resume_devices,
+                    )
+                )
+                fault_rc = _run(
+                    dataclasses.replace(base, total_epochs=4), True, n_devices=8
+                )
+                rcs.append(fault_rc)
             for rc in rcs:
                 if rc not in DOCUMENTED_RCS:
                     ep_viol.append(f"undocumented rc {rc}")
@@ -642,7 +691,9 @@ def run_campaign(
                 ep_viol.append(
                     f"rc {fault_rc} not in expected {ep.expected_rcs} for {ep.kind}"
                 )
-            if (ep.resume_after or ep.mode in ("resume", "shrink")) and rcs[-1] != 0:
+            if (
+                ep.resume_after or ep.mode in ("resume", "shrink", "grow")
+            ) and rcs[-1] != 0:
                 ep_viol.append(f"resume leg exited rc {rcs[-1]}")
             err = _check_events_jsonl(run_dir)
             if err:

@@ -2,7 +2,7 @@
 """Seeded chaos-soak campaign over the resilience subsystem.
 
 Usage:
-    python scripts/chaos_soak.py --episodes 8 --seed 0 [--work-dir DIR]
+    python scripts/chaos_soak.py --episodes 11 --seed 0 [--work-dir DIR]
         [--no-subprocess]
 
 Samples fault injections across every registered seam (checkpoint
@@ -57,7 +57,7 @@ from howtotrainyourmamlpytorch_tpu.resilience.campaign import run_campaign  # no
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("--episodes", type=int, default=8)
+    parser.add_argument("--episodes", type=int, default=11)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--work-dir",

@@ -21,11 +21,18 @@ Usage::
 
     python scripts/obs_report.py exps/<run> [--json] [--oneline]
         [--chrome-trace out.json] [--xplane-dir DIR]
+    python scripts/obs_report.py --exps-root exps [--json]
 
 ``--json`` emits the full machine-readable report, ``--oneline`` one compact
 JSON line (what ``scripts/sweep.sh`` appends per finished run),
 ``--chrome-trace`` copies the run's exported span trace (``logs/trace.json``,
 Chrome/Perfetto-loadable) to the given path.
+
+``--exps-root`` is the FLEET mode: every run directory under the root gets
+the same per-run ``build_report`` pass, slimmed to its oneline form and
+joined with the fleet scheduler's per-cell record (``fleet_cell.json``: rc
+history, restarts, status) when one exists — one table/JSON over the whole
+matrix, sharing the per-run code path rather than re-implementing it.
 
 Import-light by design (stdlib + file-path-loaded repo modules; no jax, no
 package import): a report over a finished run dir must never touch — or wait
@@ -234,6 +241,88 @@ def oneline(report: Dict[str, Any]) -> str:
     return json.dumps({k: v for k, v in slim.items() if v is not None})
 
 
+def _slim_run_row(report: Dict[str, Any], run_dir: str) -> Dict[str, Any]:
+    """One fleet-table row: the oneline fields + the fleet scheduler's
+    per-cell record (rc/restarts) when the run was fleet-driven."""
+    row = json.loads(oneline(report))
+    cell_path = os.path.join(run_dir, "fleet_cell.json")
+    if os.path.exists(cell_path):
+        try:
+            with open(cell_path) as f:
+                cell = json.load(f)
+            row.update(
+                {
+                    "status": cell.get("status"),
+                    "rcs": cell.get("rcs"),
+                    "restarts": cell.get("restarts"),
+                    "attempts": cell.get("attempts"),
+                    "seed": cell.get("seed"),
+                }
+            )
+        except (OSError, json.JSONDecodeError) as exc:
+            row["fleet_cell_error"] = repr(exc)
+    return row
+
+
+def build_fleet_report(exps_root: str) -> Dict[str, Any]:
+    """Aggregate every run dir under ``exps_root`` through the per-run
+    ``build_report`` path. A directory counts as a run when it has a
+    ``logs/`` subdirectory; runs predating the observability subsystem
+    degrade to their error row rather than being skipped silently."""
+    rows: List[Dict[str, Any]] = []
+    for name in sorted(os.listdir(exps_root)):
+        run_dir = os.path.join(exps_root, name)
+        if not os.path.isdir(os.path.join(run_dir, "logs")):
+            continue
+        rows.append(_slim_run_row(build_report(run_dir), run_dir))
+    report: Dict[str, Any] = {
+        "report": "fleet_obs",
+        "exps_root": exps_root,
+        "runs": rows,
+        "n_runs": len(rows),
+    }
+    fleet_path = os.path.join(exps_root, "fleet_report.json")
+    if os.path.exists(fleet_path):
+        try:
+            with open(fleet_path) as f:
+                fleet = json.load(f)
+            report["fleet"] = {
+                k: fleet.get(k)
+                for k in ("spec", "done", "diverged", "failed", "skipped", "ok")
+            }
+        except (OSError, json.JSONDecodeError) as exc:
+            report["fleet_report_error"] = repr(exc)
+    return report
+
+
+def render_fleet_human(report: Dict[str, Any]) -> str:
+    lines = [f"== fleet report: {report['exps_root']} ({report['n_runs']} runs) =="]
+    if report.get("fleet"):
+        f = report["fleet"]
+        lines.append(
+            f"scheduler: spec={f.get('spec')} done={f.get('done')} "
+            f"diverged={f.get('diverged')} failed={f.get('failed')} "
+            f"skipped={f.get('skipped')} ok={f.get('ok')}"
+        )
+    lines.append(
+        f"{'run':<34} {'status':<9} {'rcs':<12} {'rst':>3} {'epochs':>6} "
+        f"{'eps/s':>8} {'cov':>5}  notable"
+    )
+    for row in report["runs"]:
+        notable = row.get("notable_events") or {}
+        rcs = ",".join(str(r) for r in (row.get("rcs") or [])) or "-"
+        lines.append(
+            f"{str(row.get('run'))[:34]:<34} "
+            f"{str(row.get('status') or ('err' if row.get('error') else '-')):<9} "
+            f"{rcs:<12} {str(row.get('restarts', '-')):>3} "
+            f"{str(row.get('epochs', '-')):>6} "
+            f"{str(row.get('episodes_per_s', '-')):>8} "
+            f"{str(row.get('phase_coverage', '-')):>5}  "
+            + (" ".join(f"{k}={v}" for k, v in sorted(notable.items())) or "-")
+        )
+    return "\n".join(lines)
+
+
 def render_human(report: Dict[str, Any]) -> str:
     lines = [f"== run report: {report.get('run')} =="]
     if report.get("error"):
@@ -310,7 +399,14 @@ def render_human(report: Dict[str, Any]) -> str:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("run_dir", help="experiment run directory (exps/<name>)")
+    parser.add_argument(
+        "run_dir", nargs="?", help="experiment run directory (exps/<name>)"
+    )
+    parser.add_argument(
+        "--exps-root",
+        help="fleet mode: aggregate every run dir under this root into one "
+        "table/JSON (joined with fleet_cell.json / fleet_report.json)",
+    )
     parser.add_argument("--json", action="store_true", help="full JSON report")
     parser.add_argument(
         "--oneline", action="store_true", help="one compact JSON line (sweep logs)"
@@ -326,6 +422,23 @@ def main(argv=None) -> int:
         "(default: the run config's profile_dir)",
     )
     args = parser.parse_args(argv)
+    if args.exps_root:
+        if not os.path.isdir(args.exps_root):
+            print(f"obs_report: no such exps root: {args.exps_root}", file=sys.stderr)
+            return _RC_USAGE
+        fleet_report = build_fleet_report(args.exps_root)
+        if args.json or args.oneline:
+            print(
+                json.dumps(fleet_report)
+                if args.oneline
+                else json.dumps(fleet_report, indent=1)
+            )
+        else:
+            print(render_fleet_human(fleet_report))
+        return _RC_OK
+    if not args.run_dir:
+        print("obs_report: need a run dir or --exps-root", file=sys.stderr)
+        return _RC_USAGE
     if not os.path.isdir(args.run_dir):
         print(f"obs_report: no such run dir: {args.run_dir}", file=sys.stderr)
         return _RC_USAGE

@@ -1,125 +1,32 @@
 #!/bin/bash
-# Watchdogged serial sweep harness for real-chip accuracy runs.
+# Thin wrapper over scripts/fleet_run.py — kept for the historical CLI
+# ("sweep.sh '<name> <override...>' ..."), but the harness policy no longer
+# lives here: the restart-rc set (75/76 vs 3) comes from exit_codes.py via
+# the fleet scheduler, and the stall deadline / restart bounds are fleet
+# defaults (overridable with STALL_SECS / MAX_RESTARTS / DEADLINE_EPOCH for
+# round-script compatibility). Bash used to hardcode all three — a
+# GL302-class drift hazard graftlint can't see in shell.
 #
 # Usage: scripts/sweep.sh "<name> <override...>" ["<name> <override...>" ...]
-# Each job is one train_maml_system.py run named <name> with extra overrides.
-#
-# The chip sits behind a network tunnel that occasionally wedges mid-run
-# (device call never returns; process sleeps forever). Every epoch writes an
-# atomic checkpoint and the episode stream is a pure function of (seed, iter),
-# so the watchdog kills a run whose log goes stale and restarts it — resume
-# is exact (continue_from_epoch=latest is the default). python -u: the log
-# mtime is the liveness signal, so stdout must not sit in a block buffer.
 set -u
 cd /root/repo
-# graftlint preflight: a jax-hazard / concurrency / contract finding aborts
-# the sweep BEFORE any TPU time is burned (an un-noticed recompile or host
-# sync silently eats the whole chip budget; a typo'd fault seam makes a
-# drill a no-op). rc=1 findings / rc=2 usage both abort; the JSON payload
-# lands next to the sweep log for the post-mortem.
 mkdir -p exps
+# graftlint preflight: a jax-hazard / concurrency / contract finding aborts
+# the sweep BEFORE any TPU time is burned; the JSON payload lands next to
+# the fleet log for the post-mortem.
 if ! python scripts/lint.py --json howtotrainyourmamlpytorch_tpu scripts \
-    > exps/graftlint_preflight.json 2>> exps/sweep_r3.log; then
-  echo "=== $(date -u +%H:%M:%S) graftlint preflight FAILED (see exps/graftlint_preflight.json) — aborting sweep" >> exps/sweep_r3.log
+    > exps/graftlint_preflight.json 2>> exps/fleet.log; then
   echo "graftlint preflight failed; sweep aborted before touching the TPU" >&2
   exit 1
 fi
-COMMON="dataset=omniglot inner_optim=gd seed=0 train_seed=0 val_seed=0 \
+COMMON="dataset=omniglot inner_optim=gd \
  dataset.path=/root/reference/datasets/omniglot_dataset \
  index_cache_dir=/tmp/omniglot_idx load_into_memory=true \
  total_epochs=150 remat_inner_steps=false"
-# Epochs print every 6-90s once warm, but epoch 0 of the heavy 20-way /
-# resnet / densenet configs is compile (+eval-program compile) plus 500
-# silent train iters — comfortably over 240s on a cold XLA cache. 420s still
-# catches a wedged tunnel within one epoch's slack without kill-looping a
-# healthy first epoch.
-STALL_SECS=${STALL_SECS:-420}
-MAX_RESTARTS=${MAX_RESTARTS:-8}
-
-run () {
-  name=$1; shift
-  out="exps/${name}.out"
-  attempt=0
-  preempts=0
-  while [ "$attempt" -le "$MAX_RESTARTS" ]; do
-    # don't burn an attempt against a wedged tunnel: wait (<=1h) until a
-    # bounded probe actually sees the chip
-    python -u scripts/wait_for_tpu.py >> exps/sweep_r3.log 2>&1 || \
-      echo "=== $(date -u +%H:%M:%S) $name: TPU wait gate exited nonzero (64=deadline, 65=wedged tunnel, else launch failure), trying anyway" >> exps/sweep_r3.log
-    echo "=== $(date -u +%H:%M:%S) start $name attempt=$attempt" >> exps/sweep_r3.log
-    # appending with >> does not update mtime on spawn: reset the liveness
-    # clock so a restart gets the full STALL_SECS window
-    touch "$out"
-    python -u train_maml_system.py $COMMON experiment_name="$name" "$@" \
-      >> "$out" 2>&1 &
-    pid=$!
-    while kill -0 $pid 2>/dev/null; do
-      sleep 30
-      age=$(( $(date +%s) - $(stat -c %Y "$out") ))
-      if [ "$age" -gt "$STALL_SECS" ]; then
-        echo "=== $(date -u +%H:%M:%S) $name STALLED (log ${age}s old), killing $pid" >> exps/sweep_r3.log
-        kill $pid 2>/dev/null; sleep 5; kill -9 $pid 2>/dev/null
-        break
-      fi
-    done
-    wait $pid; rc=$?
-    echo "=== $(date -u +%H:%M:%S) $name attempt=$attempt rc=$rc" >> exps/sweep_r3.log
-    if [ $rc -eq 0 ]; then
-      # one-line observability summary (throughput, phase p50s, coverage,
-      # notable resilience events) next to the rc line — where the time of
-      # the finished run went, without opening the run dir
-      python scripts/obs_report.py "exps/${name}" --oneline >> exps/sweep_r3.log 2>&1 \
-        || echo "=== obs_report failed for $name (non-fatal)" >> exps/sweep_r3.log
-      return 0
-    fi
-    if [ $rc -eq 3 ]; then
-      # runner's divergence abort (early-abort OR exhausted NaN-rollback
-      # ladder): permanent, not a transient failure — retrying resumes the
-      # same collapsing trajectory
-      echo "=== $(date -u +%H:%M:%S) $name EARLY-ABORTED (diverged), not retrying" >> exps/sweep_r3.log
-      return 1
-    fi
-    if [ $rc -eq 75 ] || [ $rc -eq 76 ]; then
-      # restart-not-fail codes, both backed by an emergency checkpoint:
-      #   75 = runner's preemption exit (SIGTERM/SIGINT, mid-epoch cursor —
-      #        resume is exact and makes progress)
-      #   76 = runner's wedge watchdog (zero progress past the deadline;
-      #        thread stacks in logs/events.jsonl, checkpoint from the last
-      #        settled state — the loop-head TPU gate waits out the wedged
-      #        tunnel before the relaunch touches the chip)
-      # bounded: a SIGTERM-happy environment or a tunnel that wedges every
-      # epoch must not loop forever
-      preempts=$((preempts + 1))
-      if [ "$preempts" -gt $((MAX_RESTARTS * 3)) ]; then
-        echo "=== $(date -u +%H:%M:%S) $name preempted/wedged $preempts times, giving up" >> exps/sweep_r3.log
-        return 1
-      fi
-      if [ $rc -eq 76 ]; then
-        echo "=== $(date -u +%H:%M:%S) $name WEDGED (watchdog rc=76, emergency checkpoint), restarting free ($preempts)" >> exps/sweep_r3.log
-      else
-        echo "=== $(date -u +%H:%M:%S) $name PREEMPTED (emergency checkpoint), restarting free ($preempts)" >> exps/sweep_r3.log
-      fi
-      sleep 2
-      continue
-    fi
-    attempt=$((attempt + 1))
-    sleep 10   # let the tunnel lease clear before reconnecting
-  done
-  echo "=== $(date -u +%H:%M:%S) $name FAILED after $MAX_RESTARTS restarts" >> exps/sweep_r3.log
-  return 1
-}
-
-TOTAL=$#
-OK=0
-for job in "$@"; do
-  # optional deadline (epoch seconds): don't *start* a job that would
-  # overrun the round — the driver needs the chip free at round end.
-  if [ -n "${DEADLINE_EPOCH:-}" ] && [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; then
-    echo "=== $(date -u +%H:%M:%S) DEADLINE passed, skipping remaining jobs" >> exps/sweep_r3.log
-    break
-  fi
-  set -- $job
-  run "$@" && OK=$((OK + 1))
-done
-echo "=== $(date -u +%H:%M:%S) SWEEP DONE: $OK/$TOTAL jobs" >> exps/sweep_r3.log
-[ "$OK" -eq "$TOTAL" ]
+ARGS=()
+for override in $COMMON; do ARGS+=(--base "$override"); done
+for job in "$@"; do ARGS+=(--job "$job"); done
+[ -n "${STALL_SECS:-}" ] && ARGS+=(--stall-secs "$STALL_SECS")
+[ -n "${MAX_RESTARTS:-}" ] && ARGS+=(--max-restarts "$MAX_RESTARTS")
+[ -n "${DEADLINE_EPOCH:-}" ] && ARGS+=(--deadline-epoch "$DEADLINE_EPOCH")
+exec python -u scripts/fleet_run.py "${ARGS[@]}" 2>> exps/fleet.log

@@ -73,23 +73,27 @@ def test_chaos_smoke_campaign_all_invariants_green(toy_dataset, tmp_path):
 
 @pytest.mark.slow
 def test_full_chaos_soak_cli(tmp_path):
-    """The acceptance command: ``python scripts/chaos_soak.py --episodes 8
-    --seed 0`` reports every invariant green in ONE JSON line, rc 0."""
+    """The acceptance command: ``python scripts/chaos_soak.py --episodes 11
+    --seed 0`` (one full menu pass, including the ISSUE 6 grow-back and
+    SIGTERM-during-async-save episodes) reports every invariant green in
+    ONE JSON line, rc 0."""
     proc = subprocess.run(
         [
             sys.executable, "scripts/chaos_soak.py",
-            "--episodes", "8", "--seed", "0",
+            "--episodes", "11", "--seed", "0",
             "--work-dir", str(tmp_path),
         ],
         cwd=REPO,
         capture_output=True,
         text=True,
-        timeout=1800,
+        timeout=2700,
     )
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
     assert len(lines) == 1, lines
     verdict = json.loads(lines[0])
     assert verdict["ok"] is True
-    assert verdict["episodes"] == 8
+    assert verdict["episodes"] == 11
     assert verdict["violations"] == []
+    kinds = {r["kind"] for r in verdict["episode_results"]}
+    assert {"device-grow-resume", "sigterm-during-async-save"} <= kinds

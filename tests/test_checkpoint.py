@@ -2,6 +2,8 @@
 hyperparams + step) survives save/load exactly — fixing the reference's
 optimizer-state resume gap (SURVEY.md §5.4)."""
 
+import os
+
 import numpy as np
 
 from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
@@ -57,6 +59,131 @@ def test_checkpoint_embeds_verifiable_digest(tmp_path):
     assert ckpt.available_epochs(str(tmp_path)) == []
     assert not ckpt.checkpoint_exists(str(tmp_path), 0)
     assert ckpt.quarantine(str(tmp_path), 0) is None  # already gone: no-op
+
+
+def test_sharded_format3_roundtrip_and_rotation(tmp_path):
+    """Format 3: N shard files + a digest-wrapped manifest per checkpoint,
+    byte-exact roundtrip, rotation removes a checkpoint's shards with its
+    manifest while 'latest' (hardlinked shards) stays loadable."""
+    from flax import serialization
+
+    cfg = tiny_config()
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    for epoch in range(3):
+        state, _ = system.train_step(state, _as_jnp(tiny_batch(seed=epoch)))
+        ckpt.save_checkpoint(
+            str(tmp_path), state, {"epoch": epoch}, epoch,
+            max_models_to_save=2, num_shards=3,
+        )
+    names = sorted(os.listdir(tmp_path))
+    # rotation dropped epoch 0's manifest AND shards
+    assert not any(n.startswith("train_model_0") for n in names)
+    assert "train_model_2" in names
+    assert [n for n in names if n.startswith("train_model_2.shard")] == [
+        "train_model_2.shard0", "train_model_2.shard1", "train_model_2.shard2",
+    ]
+    with open(tmp_path / "train_model_2", "rb") as f:
+        outer = serialization.msgpack_restore(f.read())
+    assert outer["format"] == ckpt.SHARDED_FORMAT == 3
+    restored, book = ckpt.load_checkpoint(str(tmp_path), "latest", system.init_train_state())
+    assert book == {"epoch": 2}
+    assert tree_allclose(restored.params, state.params, rtol=0, atol=0)
+    assert tree_allclose(restored.opt_state, state.opt_state, rtol=0, atol=0)
+    # load_for_inference works without a template and fingerprints the
+    # manifest (content-addressed transitively through the shard digests)
+    inf, _ = ckpt.load_for_inference(str(tmp_path), 2)
+    assert inf.fingerprint
+    assert tree_allclose(inf.params, state.params, rtol=0, atol=0)
+
+
+def test_cross_format_fallback_chain_with_corrupt_newest(tmp_path):
+    """ISSUE 6 satellite: a resume chain holding all three generations —
+    legacy digestless (epoch 0), format-2 blob (epoch 1), format-3 sharded
+    (epoch 2 + latest) — with the newest corrupted: the fallback walks
+    ACROSS formats, quarantining as it goes, and each surviving generation
+    still loads."""
+    from flax import serialization
+
+    cfg = tiny_config()
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    states = {}
+    state = system.init_train_state()
+    for epoch in range(3):
+        state, _ = system.train_step(state, _as_jnp(tiny_batch(seed=epoch)))
+        states[epoch] = state
+    # epoch 0: legacy format 1 (bare payload, no digest wrapper)
+    import jax
+
+    legacy = serialization.msgpack_serialize(
+        {
+            "network": serialization.to_bytes(jax.tree.map(np.asarray, states[0])),
+            "bookkeeping": {"epoch": 0},
+        }
+    )
+    with open(tmp_path / "train_model_0", "wb") as f:
+        f.write(legacy)
+    # epoch 1: format-2 blob
+    ckpt.save_named(str(tmp_path), states[1], {"epoch": 1}, 1)
+    # epoch 2 (+ latest): format-3 sharded
+    ckpt.save_checkpoint(str(tmp_path), states[2], {"epoch": 2}, 2, num_shards=2)
+
+    # corrupt the NEWEST generation: flip bytes in one of epoch 2's shards
+    # (latest's hardlinks share the inode, so both manifests now fail)
+    with open(tmp_path / "train_model_2.shard0", "r+b") as f:
+        f.seek(8)
+        f.write(b"\xff\x00\xff\x00")
+    template = system.init_train_state()
+    restored, book, idx = ckpt.load_latest_with_fallback(str(tmp_path), template)
+    assert idx == 1 and book == {"epoch": 1}
+    assert tree_allclose(restored.params, states[1].params, rtol=0, atol=0)
+    # latest and epoch 2 were quarantined — manifests AND shards
+    names = sorted(os.listdir(tmp_path))
+    assert "train_model_latest.corrupt" in names
+    assert "train_model_2.corrupt" in names
+    assert "train_model_2.shard0.corrupt" in names
+    assert not ckpt.checkpoint_exists(str(tmp_path), 2)
+
+    # corrupt the format-2 blob too: the chain reaches the LEGACY file
+    with open(tmp_path / "train_model_1", "r+b") as f:
+        f.seek(8)
+        f.write(b"\x00\xff\x00\xff")
+    restored, book, idx = ckpt.load_latest_with_fallback(str(tmp_path), template)
+    assert idx == 0 and book == {"epoch": 0}
+    assert tree_allclose(restored.params, states[0].params, rtol=0, atol=0)
+
+
+def test_quarantined_shards_survive_resave_and_rotation(tmp_path):
+    """Quarantine keeps ``.shardN.corrupt`` files for forensics; a later
+    save under the SAME idx (the run resumed and reached that epoch again)
+    and rotation must not delete them, and a second quarantine must not
+    double-suffix them."""
+    cfg = tiny_config()
+    system = MAMLSystem(cfg, model=tiny_linear_model())
+    state = system.init_train_state()
+    ckpt.save_checkpoint(str(tmp_path), state, {"epoch": 0}, 0, num_shards=2)
+    ckpt.quarantine(str(tmp_path), 0)
+    forensic = "train_model_0.shard0.corrupt"
+    assert forensic in os.listdir(tmp_path)
+    # the run resumes and re-saves epoch 0: forensics untouched, new files live
+    ckpt.save_checkpoint(str(tmp_path), state, {"epoch": 0}, 0, num_shards=2)
+    assert forensic in os.listdir(tmp_path)
+    restored, _ = ckpt.load_checkpoint(str(tmp_path), 0, system.init_train_state())
+    assert tree_allclose(restored.params, state.params, rtol=0, atol=0)
+    # rotation that drops epoch 0 removes its LIVE shards only
+    for epoch in range(1, 4):
+        ckpt.save_checkpoint(
+            str(tmp_path), state, {"epoch": epoch}, epoch,
+            max_models_to_save=2, num_shards=2,
+        )
+    names = os.listdir(tmp_path)
+    assert forensic in names
+    assert "train_model_0" not in names and "train_model_0.shard0" not in names
+    # a second quarantine of a re-corrupted idx never double-suffixes
+    ckpt.quarantine(str(tmp_path), 3)
+    ckpt.save_checkpoint(str(tmp_path), state, {"epoch": 3}, 3, num_shards=2)
+    ckpt.quarantine(str(tmp_path), 3)
+    assert not any(n.endswith(".corrupt.corrupt") for n in os.listdir(tmp_path))
 
 
 def test_rotation_keeps_max_models(tmp_path):

@@ -1,0 +1,385 @@
+"""Fleet campaign scheduler (resilience/fleet.py + scripts/fleet_run.py).
+
+The scheduler's whole value is its rc policy — consumed straight from
+``exit_codes.py`` — so the fast tests drive it with scripted child processes
+that exit exactly the codes a real run would (75 preemption, 76 wedge, 3
+divergence, stalls), and the e2e test drives a real 2-config x 2-seed toy
+matrix through ``fleet_run``-shaped plumbing with injected first-attempt
+faults, asserting bounded restarts, exact resume, and one fleet-report JSON.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+import yaml
+
+from howtotrainyourmamlpytorch_tpu import exit_codes
+from howtotrainyourmamlpytorch_tpu.resilience.fleet import (
+    FleetScheduler,
+    FleetSpec,
+)
+
+from tests.test_runner import toy_dataset  # noqa: F401
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(tmp_path, configs, seeds=(0,), **kw):
+    defaults = dict(
+        name="test_fleet",
+        configs=configs,
+        seeds=list(seeds),
+        experiment_root=str(tmp_path / "exps"),
+        poll_s=0.02,
+        stall_deadline_s=0.0,  # off unless a test arms it
+        gate_retry_s=0.01,
+    )
+    defaults.update(kw)
+    return FleetSpec(**defaults)
+
+
+def _exit_child(rc: int):
+    return subprocess.Popen([sys.executable, "-c", f"raise SystemExit({rc})"])
+
+
+def _scripted_launcher(script):
+    """Per-cell list of exit codes; each launch pops the next one. The
+    scheduler sees real subprocesses, just with scripted verdicts."""
+    launches = []
+
+    def launcher(cell, attempt):
+        rc = script[cell.name].pop(0)
+        launches.append((cell.name, rc))
+        return _exit_child(rc), None
+
+    return launcher, launches
+
+
+def test_spec_cells_cross_configs_and_seeds(tmp_path):
+    spec = _spec(
+        tmp_path,
+        [{"name": "a", "overrides": ["x=1"]}, {"name": "b", "overrides": []}],
+        seeds=(0, 1),
+        base_overrides=["net=vgg"],
+    )
+    cells = spec.cells()
+    assert [c.name for c in cells] == ["a.s0", "a.s1", "b.s0", "b.s1"]
+    assert cells[1].overrides == ["net=vgg", "seed=1", "train_seed=1", "val_seed=1", "x=1"]
+    # a job that pins its OWN seed wins over the matrix default (overrides
+    # are last-wins at config load; the retired sweep drivers embedded
+    # seeds in the job string — they must not be silently relabeled s0)
+    pinned = FleetSpec(
+        name="p", configs=[{"name": "j", "overrides": ["seed=2", "train_seed=2"]}],
+    ).cells()[0]
+    assert pinned.overrides.index("seed=0") < pinned.overrides.index("seed=2")
+    # yaml round-trip incl. the sweep.sh job shorthand
+    data = {"fleet": {"name": "y", "configs": ["j1 k=2", {"name": "j2"}], "seeds": [3]}}
+    spec2 = FleetSpec.from_dict(data)
+    assert [c.name for c in spec2.cells()] == ["j1.s3", "j2.s3"]
+    assert spec2.cells()[0].overrides[-1] == "k=2"  # job overrides win (last)
+    with pytest.raises(ValueError):
+        FleetSpec.from_dict({"fleet": {"configs": [], "name": "empty"}})
+    with pytest.raises(ValueError):
+        FleetSpec.from_dict({"fleet": {"configs": ["dup"], "bogus_knob": 1}})
+
+
+def test_rc_policy_matrix_restarts_diverged_and_report(tmp_path):
+    """The acceptance shape: a 2-config x 2-seed matrix under injected
+    rc=75 and rc=76 child exits — both restart (bounded, without burning an
+    attempt), rc=3 is terminal-diverged, and ONE fleet-report JSON lands."""
+    script = {
+        "a.s0": [exit_codes.PREEMPTED, exit_codes.OK],
+        "a.s1": [exit_codes.WEDGED, exit_codes.OK],
+        "b.s0": [exit_codes.DIVERGED],
+        "b.s1": [exit_codes.OK],
+    }
+    launcher, launches = _scripted_launcher(script)
+    spec = _spec(
+        tmp_path, [{"name": "a", "overrides": []}, {"name": "b", "overrides": []}],
+        seeds=(0, 1),
+    )
+    sched = FleetScheduler(
+        spec, launcher=launcher, gate=lambda: 0, obs=lambda run_dir: None,
+        log=lambda m: None,
+    )
+    report = sched.run()
+    assert report["ok"] is True
+    assert report["done"] == 3 and report["diverged"] == 1 and report["failed"] == 0
+    by_name = {c["name"]: c for c in report["cells"]}
+    assert by_name["a.s0"]["rcs"] == [75, 0] and by_name["a.s0"]["restarts"] == 1
+    assert by_name["a.s1"]["rcs"] == [76, 0] and by_name["a.s1"]["restarts"] == 1
+    assert by_name["a.s0"]["attempts"] == 0  # free restarts burn no attempt
+    assert by_name["b.s0"]["status"] == "diverged" and by_name["b.s0"]["rcs"] == [3]
+    # restart relaunches the SAME cell name => same run dir => exact resume
+    assert [n for n, _ in launches].count("a.s0") == 2
+    # one report JSON + parseable event stream on disk
+    with open(os.path.join(spec.experiment_root, "fleet_report.json")) as f:
+        assert json.load(f)["ok"] is True
+    with open(os.path.join(spec.experiment_root, "fleet_events.jsonl")) as f:
+        events = [json.loads(line)["event"] for line in f if line.strip()]
+    assert "cell_restart" in events and "fleet_done" in events
+
+
+def test_restart_budget_bounds_a_wedge_loop(tmp_path):
+    """A cell that wedges forever fails after restart_budget relaunches
+    instead of looping — the sweep.sh bound, now tested."""
+    script = {"w.s0": [exit_codes.WEDGED] * 10}
+    launcher, launches = _scripted_launcher(script)
+    spec = _spec(
+        tmp_path, [{"name": "w", "overrides": []}],
+        max_restarts=1, restart_budget=2,
+    )
+    sched = FleetScheduler(
+        spec, launcher=launcher, gate=lambda: 0, obs=lambda d: None,
+        log=lambda m: None,
+    )
+    report = sched.run()
+    cell = report["cells"][0]
+    assert cell["status"] == "failed" and cell["restarts"] == 3
+    assert len(launches) == 3  # initial + 2 budgeted restarts
+    assert report["ok"] is False
+
+
+def test_unknown_rc_burns_attempts_until_failed(tmp_path):
+    script = {"u.s0": [17, 17, 17]}
+    launcher, launches = _scripted_launcher(script)
+    spec = _spec(tmp_path, [{"name": "u", "overrides": []}], max_restarts=2)
+    report = FleetScheduler(
+        spec, launcher=launcher, gate=lambda: 0, obs=lambda d: None,
+        log=lambda m: None,
+    ).run()
+    cell = report["cells"][0]
+    assert cell["status"] == "failed" and cell["attempts"] == 3
+    assert cell["rcs"] == [17, 17, 17]
+
+
+def test_gate_64_65_pause_the_queue_until_clear(tmp_path):
+    """TPU-gate rcs (64/65) hold the launch; the cell starts only once the
+    gate clears, and the pauses are logged."""
+    gates = [exit_codes.TPU_WAIT_WEDGED, exit_codes.TPU_WAIT_DEADLINE, 0]
+    script = {"g.s0": [exit_codes.OK]}
+    launcher, launches = _scripted_launcher(script)
+    spec = _spec(tmp_path, [{"name": "g", "overrides": []}])
+    report = FleetScheduler(
+        spec, launcher=launcher, gate=lambda: gates.pop(0),
+        obs=lambda d: None, log=lambda m: None,
+    ).run()
+    assert report["cells"][0]["status"] == "done"
+    assert gates == []  # all three gate probes consumed before the launch
+    with open(os.path.join(spec.experiment_root, "fleet_events.jsonl")) as f:
+        events = [json.loads(line)["event"] for line in f if line.strip()]
+    assert events.count("gate_paused") == 2
+
+
+def test_default_gate_skips_on_explicit_cpu_platform(tmp_path, monkeypatch):
+    """A CPU-only environment has no tunnel to gate on: the default gate
+    must return OK immediately under JAX_PLATFORMS=cpu (probing for a TPU
+    there would block the queue for the whole gate deadline with no way to
+    ever succeed), and spec.tpu_gate=false skips it unconditionally."""
+    from howtotrainyourmamlpytorch_tpu.resilience import fleet as fleet_mod
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert fleet_mod._default_gate() == exit_codes.OK
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu,axon")
+    assert fleet_mod._default_gate() == exit_codes.OK
+    # gateless spec: scheduler never probes at all, whatever the env
+    spec = _spec(tmp_path, [{"name": "a", "overrides": []}], tpu_gate=False)
+    launcher, _ = _scripted_launcher({"a.s0": [exit_codes.OK]})
+    report = FleetScheduler(
+        spec, launcher=launcher, obs=lambda d: None, log=lambda m: None
+    ).run()
+    assert report["cells"][0]["status"] == "done"
+
+
+def test_stalled_child_is_killed_and_relaunched(tmp_path):
+    """A child whose output log goes silent past stall_deadline_s is killed
+    and the cell relaunched — the harness-side wedge defense."""
+    exps = tmp_path / "exps"
+    exps.mkdir()
+    out_path = str(exps / "s.s0.out")
+    calls = []
+
+    def launcher(cell, attempt):
+        calls.append(attempt)
+        if len(calls) == 1:
+            open(out_path, "w").close()
+            return (
+                subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"]),
+                out_path,
+            )
+        return _exit_child(0), None
+
+    spec = _spec(
+        tmp_path, [{"name": "s", "overrides": []}],
+        stall_deadline_s=0.3, poll_s=0.05,
+    )
+    t0 = time.monotonic()
+    report = FleetScheduler(
+        spec, launcher=launcher, gate=lambda: 0, obs=lambda d: None,
+        log=lambda m: None,
+    ).run()
+    cell = report["cells"][0]
+    assert cell["status"] == "done"
+    assert cell["stall_kills"] == 1 and cell["attempts"] == 1
+    assert time.monotonic() - t0 < 30  # killed the 60s sleeper, not waited out
+
+
+def test_deadline_epoch_skips_remaining_cells(tmp_path):
+    script = {"a.s0": [exit_codes.OK], "b.s0": [exit_codes.OK]}
+    launcher, launches = _scripted_launcher(script)
+    now = {"t": 1000.0}
+    spec = _spec(
+        tmp_path,
+        [{"name": "a", "overrides": []}, {"name": "b", "overrides": []}],
+        deadline_epoch=1500.0,
+    )
+
+    def walltime():
+        return now["t"]
+
+    def launcher_and_advance(cell, attempt):
+        now["t"] = 2000.0  # the first launch crosses the deadline
+        return launcher(cell, attempt)
+
+    report = FleetScheduler(
+        spec, launcher=launcher_and_advance, gate=lambda: 0,
+        obs=lambda d: None, walltime=walltime, log=lambda m: None,
+    ).run()
+    by_name = {c["name"]: c for c in report["cells"]}
+    assert by_name["a.s0"]["status"] == "done"
+    assert by_name["b.s0"]["status"] == "skipped"
+    assert report["ok"] is False
+
+
+def test_fleet_run_cli_dry_run_and_spec_file(tmp_path):
+    spec_path = str(tmp_path / "spec.yaml")
+    with open(spec_path, "w") as f:
+        yaml.safe_dump(
+            {"fleet": {"name": "cli", "configs": ["c1 x=1", "c2 y=2"], "seeds": [0, 1]}},
+            f,
+        )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_run.py"),
+         spec_path, "--dry-run", "--select", "c1"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    plan = json.loads(proc.stdout)
+    assert [c["name"] for c in plan["cells"]] == ["c1.s0", "c1.s1"]
+    # inline --job form (the sweep.sh wrapper path)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_run.py"),
+         "--job", "j Xk=1", "--base", "net=vgg", "--seeds", "5", "--dry-run"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    plan = json.loads(proc.stdout)
+    assert plan["cells"][0]["name"] == "j.s5"
+    overrides = plan["cells"][0]["overrides"]
+    assert overrides[0] == "net=vgg" and overrides[-1] == "Xk=1"
+    assert "seed=5" in overrides
+    # usage errors are rc=2 (the registry's USAGE)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_run.py")],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == exit_codes.USAGE
+
+
+def test_real_training_matrix_with_injected_preemption(toy_dataset, tmp_path):
+    """E2E: a real 2-config x 2-seed toy matrix driven to completion
+    unattended. One cell's FIRST attempt gets a SIGTERM fault (rc=75,
+    emergency checkpoint), one cell's first attempt exits an injected
+    rc=76 — both resume exactly and finish; the fleet report and the
+    obs_report --exps-root aggregation cover all four runs."""
+    from howtotrainyourmamlpytorch_tpu.config import save_config
+    from howtotrainyourmamlpytorch_tpu.resilience.campaign import (
+        _child_env,
+        campaign_config,
+    )
+
+    exps_root = str(tmp_path / "exps")
+    os.makedirs(exps_root)
+
+    def launcher(cell, attempt):
+        n_way = 3 if cell.config == "toy3" else 2
+        cfg = campaign_config(
+            toy_dataset, exps_root, cell.name,
+            num_classes_per_set=n_way,
+            seed=cell.seed, train_seed=cell.seed, val_seed=cell.seed,
+        )
+        if cell.name == "toy2.s1" and attempt == 0 and not cell.restarts:
+            # injected rc=76 first attempt (the wedge drill itself is
+            # covered bit-for-bit in test_wedge_watchdog)
+            return _exit_child(exit_codes.WEDGED), None
+        cfg_yaml = str(tmp_path / f"{cell.name}_a{attempt}r{cell.restarts}.yaml")
+        save_config(cfg, cfg_yaml)
+        env = _child_env(8)
+        if cell.name == "toy3.s0" and attempt == 0 and not cell.restarts:
+            # real preemption mid-run: SIGTERM at dispatch 3 -> rc=75 with
+            # an emergency mid-epoch checkpoint; the relaunch must resume it
+            env["HTYMP_FAULTS"] = "runner.step=sigterm:nth=3"
+        code = (
+            "import sys;"
+            "from howtotrainyourmamlpytorch_tpu.resilience.campaign "
+            "import child_train_main;"
+            "sys.exit(child_train_main(sys.argv[1]))"
+        )
+        out_path = os.path.join(exps_root, f"{cell.name}.out")
+        out = open(out_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code, cfg_yaml],
+            cwd=REPO, env=env, stdout=out, stderr=subprocess.STDOUT,
+        )
+        out.close()
+        return proc, out_path
+
+    spec = _spec(
+        tmp_path,
+        [{"name": "toy3", "overrides": []}, {"name": "toy2", "overrides": []}],
+        seeds=(0, 1),
+        poll_s=0.2,
+        experiment_root=exps_root,
+    )
+    report = FleetScheduler(
+        spec, launcher=launcher, gate=lambda: 0, log=lambda m: None
+    ).run()
+    assert report["ok"] is True, report
+    assert report["done"] == 4 and report["failed"] == 0
+    by_name = {c["name"]: c for c in report["cells"]}
+    assert by_name["toy3.s0"]["rcs"] == [exit_codes.PREEMPTED, exit_codes.OK]
+    assert by_name["toy2.s1"]["rcs"] == [exit_codes.WEDGED, exit_codes.OK]
+    # the preempted cell RESUMED (same run dir carries the preempted event
+    # and then a completed test summary)
+    run_dir = os.path.join(exps_root, "toy3.s0")
+    with open(os.path.join(run_dir, "logs", "events.jsonl")) as f:
+        events = [json.loads(line).get("event") for line in f if line.strip()]
+    assert "preempted" in events
+    assert os.path.exists(os.path.join(run_dir, "logs", "test_summary.csv"))
+    # per-cell obs rode the shared obs_report code path
+    assert by_name["toy3.s0"]["obs"] is not None
+    assert os.path.exists(os.path.join(run_dir, "fleet_cell.json"))
+    # fleet-mode obs_report aggregates every run + the scheduler verdict
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         "--exps-root", exps_root, "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    fleet_obs = json.loads(proc.stdout)
+    assert fleet_obs["n_runs"] == 4
+    rows = {r["run"]: r for r in fleet_obs["runs"]}
+    assert rows["toy3.s0"]["rcs"] == [75, 0] and rows["toy3.s0"]["restarts"] == 1
+    assert fleet_obs["fleet"]["ok"] is True
+    # human table renders too
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         "--exps-root", exps_root],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "fleet report" in proc.stdout and "toy2.s1" in proc.stdout

@@ -605,6 +605,27 @@ def test_self_gate_covers_observability_paths_explicitly():
     )
 
 
+def test_self_gate_covers_fleet_paths_explicitly():
+    """The fleet scheduler and its CLI sit inside the self-gate on their
+    own terms (ISSUE 6): they are the code that CONSUMES the rc registry
+    the contract rules guard, so a bare exit-code literal or a threaded
+    read-modify-write creeping in here must fail tier-1, not review."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join("howtotrainyourmamlpytorch_tpu", "resilience", "fleet.py"),
+                os.path.join("scripts", "fleet_run.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in fleet paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_catches_an_introduced_true_positive(tmp_path):
     """End-to-end: drop one fixture true positive next to real package code
     and the CLI must exit 1 with a GL id on stdout."""
