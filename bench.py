@@ -53,15 +53,13 @@ STARTUP_TIMEOUT_S = float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", 90.0))
 STARTUP_DEADLINE_S = float(os.environ.get("BENCH_STARTUP_DEADLINE_S", 1800.0))
 METRIC = "meta_steps_per_sec_omniglot20w5s_vgg_b8_5steps_2nd_order"
 
-# Dense bf16 peak FLOP/s per chip, keyed by substring of device_kind.
-_PEAK_FLOPS = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),
-    ("v5e", 197e12),
-    ("v5litepod", 197e12),
-    ("v4", 275e12),
-]
+# CPU benching is allowed either explicitly (BENCH_ALLOW_CPU=1) or when the
+# caller *asked* for CPU (JAX_PLATFORMS=cpu) — the guard below exists to
+# catch the tunnel's silent CPU fallback, not a deliberate CPU run.
+_ALLOW_CPU = (
+    os.environ.get("BENCH_ALLOW_CPU") == "1"
+    or os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+)
 
 
 def _fail(msg: str, rc: int = None) -> None:
@@ -103,7 +101,7 @@ def _wait_for_backend(deadline_s: float) -> None:
     status = wait_for_backend(
         deadline_s,
         STARTUP_TIMEOUT_S,
-        allow_cpu=os.environ.get("BENCH_ALLOW_CPU") == "1",
+        allow_cpu=_ALLOW_CPU,
         label="bench",
         log=lambda m: print(m, file=sys.stderr, flush=True),
         max_consecutive_wedged=max_wedged,
@@ -145,11 +143,17 @@ def _contact_device():
 
 
 def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    for sub, peak in _PEAK_FLOPS:
-        if sub in kind:
-            return peak
-    return None
+    """Chip-peak table lookup (observability/costs.py owns the table);
+    None for unknown kinds — and on any import surprise, because the peak
+    is a diagnostic, never worth the headline."""
+    try:
+        from howtotrainyourmamlpytorch_tpu.observability.costs import (
+            peak_flops_per_sec,
+        )
+
+        return peak_flops_per_sec(device_kind)
+    except Exception:
+        return None
 
 
 class _Watchdog:
@@ -276,11 +280,12 @@ def main():
         file=sys.stderr,
         flush=True,
     )
-    if platform == "cpu" and os.environ.get("BENCH_ALLOW_CPU") != "1":
+    if platform == "cpu" and not _ALLOW_CPU:
         _fail(
             "backend fell back to host CPU (tunneled TPU plugin failed); "
             "a single-core CPU number is not comparable to the per-chip "
-            "baseline — set BENCH_ALLOW_CPU=1 to bench on CPU anyway"
+            "baseline — set BENCH_ALLOW_CPU=1 (or JAX_PLATFORMS=cpu "
+            "explicitly) to bench on CPU anyway"
         )
 
     report = {
@@ -319,11 +324,9 @@ def main():
 
     # persistent XLA cache (same dir as the training entry point): a re-run of
     # this exact program skips the first compile entirely
-    if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.expanduser("~"), ".cache", "htymp_tpu_xla"),
-        )
+    from howtotrainyourmamlpytorch_tpu.utils.compcache import setup_compilation_cache
+
+    setup_compilation_cache()
 
     from howtotrainyourmamlpytorch_tpu.config import Config
     from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
@@ -379,7 +382,8 @@ def main():
     print(f"bench: compile+warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     wd.enter("measure", 600)
-    n_iters = 30
+    # BENCH_MEASURE_ITERS: CI/CPU shake-out knob; the chip headline keeps 30
+    n_iters = int(os.environ.get("BENCH_MEASURE_ITERS", "30"))
     start = time.perf_counter()
     for _ in range(n_iters):
         state, out = system.train_step(state, batch, epoch=0)
@@ -404,6 +408,9 @@ def main():
     # degrades to phase_breakdown=null, never costs the headline.
     wd.enter("phase-breakdown", 300)
     phase_breakdown = None
+    # BENCH_PHASE_ITERS: CI/CPU shake-out knob (same contract as
+    # BENCH_MEASURE_ITERS); the chip capture keeps 12
+    n_phase = int(os.environ.get("BENCH_PHASE_ITERS", "12"))
     try:
         import numpy as np
 
@@ -411,7 +418,7 @@ def main():
 
         reg = MetricsRegistry()
         pending = None
-        for _ in range(12):
+        for _ in range(n_phase):
             with reg.timer("phase.data_wait"):
                 step_batch = batch  # resident synthetic batch: no assembly
             with reg.timer("phase.dispatch"):
@@ -487,37 +494,42 @@ def main():
           f"(K={steps_per_dispatch})", file=sys.stderr, flush=True)
 
     # --- FLOPs per meta-step #1: XLA cost analysis of the exact compiled
-    # program (may be unimplemented by the PJRT plugin -> None, never a crash).
+    # program, via observability/costs.py — the robust fallback chain
+    # (lowered -> compiled analyses, every plugin return shape normalized)
+    # that degrades to null-with-stderr-reason, never a crash. The old
+    # hand-rolled chain here died INSIDE jax while merely accessing
+    # Lowered.cost_analysis ('NoneType' object has no attribute 'get',
+    # BENCH_r02), nulling flops_per_step/mfu in every BENCH line.
     wd.enter("cost-analysis", 600)
-    flops_hlo = None
-    try:
-        # same program variant the timed loop selected for epoch=0
-        lowered = system._compiled_train_step(
-            system.use_second_order(0), system.msl_active(0)
-        ).lower(state, batch)
-        for get in (lowered.cost_analysis, lambda: lowered.compile().cost_analysis()):
-            try:
-                ca = get()
-            except Exception:
-                continue
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0] if ca else None
-            if ca is not None and float(ca.get("flops", 0.0) or 0.0) > 0:
-                flops_hlo = float(ca["flops"])
-                break
-    except Exception as e:
-        print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)
+    from howtotrainyourmamlpytorch_tpu.observability import costs as obs_costs
+
+    # same program variant the timed loop selected for epoch=0
+    cost = obs_costs.jit_cost(
+        system._compiled_train_step(system.use_second_order(0), system.msl_active(0)),
+        state,
+        batch,
+    )
+    flops_hlo = cost.get("flops")
+    if not flops_hlo:
+        print(
+            f"bench: cost_analysis unavailable: {cost.get('error')}",
+            file=sys.stderr,
+        )
+    else:
+        wd.update(bytes_accessed_per_step=cost.get("bytes_accessed"))
     if flops_hlo:
         # provisional MFU goes into the report NOW: a wedge in the (riskier)
         # trace/b16 arms below must not cost the capture its mfu when the
         # HLO FLOPs are already known; the trace-based numbers refine it in
         # the final report
-        peak0 = _peak_flops(device_kind)
+        mfu0, mfu0_reason = obs_costs.mfu(flops_hlo, steps_per_sec, device_kind)
+        if mfu0_reason:
+            print(f"bench: mfu unavailable: {mfu0_reason}", file=sys.stderr)
         wd.update(
             flops_per_step=flops_hlo,
             flops_source="hlo",
-            peak_flops_per_sec=peak0,
-            mfu=(round(flops_hlo * steps_per_sec / peak0, 5) if peak0 else None),
+            peak_flops_per_sec=_peak_flops(device_kind),
+            mfu=mfu0,
         )
 
     # --- device-time breakdown + measured FLOPs from a short jax.profiler
@@ -530,7 +542,8 @@ def main():
         from howtotrainyourmamlpytorch_tpu.utils.profiling import device_time_breakdown
 
         trace_dir = "/tmp/bench_trace"
-        n_prof = 5
+        # BENCH_TRACE_ITERS: CI/CPU shake-out knob; the chip capture keeps 5
+        n_prof = int(os.environ.get("BENCH_TRACE_ITERS", "5"))
         jax.profiler.start_trace(trace_dir)
         t0 = time.perf_counter()
         for _ in range(n_prof):
@@ -615,9 +628,13 @@ def main():
     # chip peak from the trace's own plane stat, table as fallback. ---
     flops_per_step = flops_measured or flops_hlo
     peak = trace_peak or _peak_flops(device_kind)
-    mfu = None
-    if flops_per_step and peak:
-        mfu = round(flops_per_step * steps_per_sec / peak, 5)
+    mfu, mfu_reason = obs_costs.mfu(
+        flops_per_step, steps_per_sec, device_kind, peak=peak
+    )
+    if mfu_reason:
+        # the null-only-with-logged-reason contract: a null mfu in the JSON
+        # line always has its reason on stderr
+        print(f"bench: mfu unavailable: {mfu_reason}", file=sys.stderr)
 
     wd.update(
         b16_steps_per_sec=(

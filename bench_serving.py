@@ -69,7 +69,18 @@ def main(argv=None) -> int:
     system = MAMLSystem(
         cfg, model=build_vgg(img, args.n_way, num_stages=stages, cnn_num_filters=filters)
     )
-    engine = AdaptationEngine(system, system.init_train_state())
+    # collector-only compile ledger (observability/compile_ledger.py): the
+    # serving programs' compile tax and per-program FLOPs ride the one-line
+    # JSON, so the cold-start cost a fresh replica pays is a tracked number
+    from howtotrainyourmamlpytorch_tpu.observability import costs as obs_costs
+    from howtotrainyourmamlpytorch_tpu.observability.compile_ledger import (
+        CompileLedger,
+    )
+
+    ledger = CompileLedger()
+    engine = AdaptationEngine(
+        system, system.init_train_state(), compile_ledger=ledger
+    )
 
     def episode(seed):
         b = synthetic_batch(1, args.n_way, args.k_shot, cfg.num_target_samples, img, seed)
@@ -151,6 +162,30 @@ def main(argv=None) -> int:
             for name, s in reg.summaries("phase.").items()
         },
     }
+    # cost model + compile tax: per-query FLOPs of the headline predict
+    # program (batched dispatch FLOPs over its batch x query count) and the
+    # ledger totals; mfu null-with-reason off-chip like bench.py
+    summary = ledger.summary()
+    result["compile_tax_s"] = summary["total_s"]
+    # program keys are serve_predict/<query-bucket>/<task-batch>; take the
+    # widest-batch priced program (the throughput headline's dispatch shape)
+    flops_per_query = None
+    best_batch = 0
+    for name, p in summary["by_program"].items():
+        if not (name.startswith("serve_predict/") and p.get("flops")):
+            continue
+        _, bucket, b = name.split("/")
+        if int(b) > best_batch:
+            best_batch = int(b)
+            flops_per_query = p["flops"] / (int(b) * int(bucket))
+    result["predict_flops_per_query"] = flops_per_query
+    device_kind = str(jax.devices()[0].device_kind)
+    mfu_value, mfu_reason = obs_costs.mfu(
+        flops_per_query, queries_per_sec, device_kind
+    )
+    if mfu_reason:
+        print(f"bench_serving: mfu unavailable: {mfu_reason}", file=sys.stderr)
+    result["mfu"] = mfu_value
     print(json.dumps(result), flush=True)
     return 0
 

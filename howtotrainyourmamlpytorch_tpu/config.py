@@ -159,6 +159,17 @@ class ObservabilityConfig:
     snapshot_every_steps: int = 0
     # write logs/trace.json (Chrome trace-event JSON) when the run closes
     export_chrome_trace: bool = True
+    # per-program compile ledger (observability/compile_ledger.py): every
+    # XLA compile recorded to logs/compile_ledger.jsonl with lower/compile
+    # seconds, persistent-cache hit/miss, and program FLOPs — the evidence
+    # base the AOT/cold-start work (ROADMAP item 2) reads.
+    compile_ledger: bool = True
+    # per-device HBM watermark provider (observability/memory.py): live and
+    # peak bytes-in-use + headroom embedded in every telemetry snapshot
+    memory_watermarks: bool = True
+    # headroom fraction below which a one-shot (per device) hbm_headroom_low
+    # event lands in events.jsonl — the pre-OOM breadcrumb
+    hbm_headroom_warn_frac: float = 0.05
 
     def __post_init__(self):
         if self.histogram_window < 1:
@@ -175,6 +186,11 @@ class ObservabilityConfig:
             raise ValueError(
                 f"observability.snapshot_every_steps must be >= 0, "
                 f"got {self.snapshot_every_steps}"
+            )
+        if not 0.0 <= self.hbm_headroom_warn_frac < 1.0:
+            raise ValueError(
+                f"observability.hbm_headroom_warn_frac must be in [0, 1), "
+                f"got {self.hbm_headroom_warn_frac}"
             )
 
 
@@ -484,6 +500,11 @@ class Config:
     # is a bug, not a convenience.
     strict_recompile_guard: bool = False
     profile_dir: str = ""  # non-empty: write jax.profiler traces here
+    # Persistent XLA compilation cache directory (utils/compcache.py — the
+    # one copy of the setup every entry point used to duplicate). Empty =
+    # the JAX_COMPILATION_CACHE_DIR env var, else the shared default
+    # ~/.cache/htymp_tpu_xla.
+    compilation_cache_dir: str = ""
     # XLA matmul/conv precision for f32 operands. On TPU the "default" is a
     # single bfloat16 MXU pass (8-bit mantissa) even when tensors are f32 —
     # fine for forward inference, but the unrolled second-order meta-gradient

@@ -193,13 +193,40 @@ class MAMLSystem:
             self.recompile_guard = RecompileGuard(
                 planned=train_planned_programs(cfg), name="maml-system"
             )
+        # compile ledger (observability/compile_ledger.py): when attached,
+        # every program build below is wrapped so its XLA compiles are timed
+        # and priced; None (the default) keeps builds exactly as they were
+        self.compile_ledger = None
         self._note_program(("eval",))
-        self._eval_step = jax.jit(self._eval_step_impl)
+        self._eval_step = self._build_program(("eval",), lambda: jax.jit(self._eval_step_impl))
         self._eval_multi = None
 
     def _note_program(self, key) -> None:
         if self.recompile_guard is not None:
             self.recompile_guard.note(key)
+
+    def _build_program(self, key, build):
+        """One program-cache insert: build the jitted fn and, when a compile
+        ledger is attached, wrap it so its compiles become ledger entries."""
+        fn = build()
+        if self.compile_ledger is not None:
+            fn = self.compile_ledger.wrap_build(key, fn)
+        return fn
+
+    def attach_compile_ledger(self, ledger) -> None:
+        """Route every program build through ``ledger`` (and hand it to the
+        strict guard for its wrap() seam). The eval program was built
+        eagerly at construction — rebuild it through the ledger so its
+        compile is priced too (costs one re-trace if eval already ran;
+        callers attach before the first dispatch)."""
+        self.compile_ledger = ledger
+        if self.recompile_guard is not None:
+            self.recompile_guard.ledger = ledger
+        if ledger is not None:
+            self._eval_step = self._build_program(
+                ("eval",), lambda: jax.jit(self._eval_step_impl)
+            )
+            self._eval_multi = None
 
     # ------------------------------------------------------------------
     # state
@@ -270,7 +297,9 @@ class MAMLSystem:
             # recompiled against the new programs are not violations
             self.recompile_guard.reset()
         self._note_program(("eval",))  # re-jitted below: count the lowering
-        self._eval_step = jax.jit(self._eval_step_impl)
+        self._eval_step = self._build_program(
+            ("eval",), lambda: jax.jit(self._eval_step_impl)
+        )
         self._eval_multi = None
 
     # ------------------------------------------------------------------
@@ -614,11 +643,14 @@ class MAMLSystem:
         if key not in self._train_step_cache:
             self._note_program(("train",) + key)
             donate = (0,) if self.cfg.donate_train_state else ()
-            self._train_step_cache[key] = jax.jit(
-                functools.partial(
-                    self._train_step_impl, second_order=second_order, msl_active=msl_active
+            self._train_step_cache[key] = self._build_program(
+                ("train",) + key,
+                lambda: jax.jit(
+                    functools.partial(
+                        self._train_step_impl, second_order=second_order, msl_active=msl_active
+                    ),
+                    donate_argnums=donate,
                 ),
-                donate_argnums=donate,
             )
         return self._train_step_cache[key]
 
@@ -705,11 +737,14 @@ class MAMLSystem:
         if key not in self._train_multi_cache:
             self._note_program(("train_multi",) + key)
             donate = (0,) if self.cfg.donate_train_state else ()
-            self._train_multi_cache[key] = jax.jit(
-                functools.partial(
-                    self._train_multi_impl, second_order=second_order, msl_active=msl_active
+            self._train_multi_cache[key] = self._build_program(
+                ("train_multi",) + key,
+                lambda: jax.jit(
+                    functools.partial(
+                        self._train_multi_impl, second_order=second_order, msl_active=msl_active
+                    ),
+                    donate_argnums=donate,
                 ),
-                donate_argnums=donate,
             )
         return self._train_multi_cache[key]
 
@@ -752,5 +787,7 @@ class MAMLSystem:
         ``(per_task_losses [N, B], per_task_accuracies [N, B])``."""
         if self._eval_multi is None:
             self._note_program(("eval_multi",))
-            self._eval_multi = jax.jit(self._eval_multi_impl)
+            self._eval_multi = self._build_program(
+                ("eval_multi",), lambda: jax.jit(self._eval_multi_impl)
+            )
         return self._eval_multi(state, batches)

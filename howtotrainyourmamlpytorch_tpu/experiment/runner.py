@@ -108,6 +108,40 @@ class ExperimentRunner:
         # false; providers are registered at the end of __init__ once the
         # system/loader/watchdog exist.
         self.hub = TelemetryHub.from_config(cfg.observability, logs_dir=self.logs_dir)
+        # --- performance observability (observability/{costs,compile_ledger,
+        # memory}.py): the compile ledger prices every XLA compile into
+        # logs/compile_ledger.jsonl (and feeds the flops_per_step gauge the
+        # live MFU snapshot field reads); the memory provider embeds HBM
+        # watermarks in every snapshot. Both inert with the hub disabled.
+        self._compile_ledger = None
+        self._memory = None
+        if self.hub.enabled:
+            from ..observability import costs as obs_costs
+
+            device_kind = str(jax.devices()[0].device_kind)
+            self.hub.registry.set_gauge("device_kind", device_kind)
+            peak = obs_costs.peak_flops_per_sec(device_kind)
+            if peak:
+                self.hub.registry.set_gauge("peak_flops_per_sec", peak)
+            else:
+                self.hub.registry.set_gauge(
+                    "mfu_unavailable_reason",
+                    f"no peak-FLOPs table entry for device_kind {device_kind!r}",
+                )
+            if cfg.observability.compile_ledger:
+                from ..observability.compile_ledger import CompileLedger
+
+                self._compile_ledger = CompileLedger(
+                    logs_dir=self.logs_dir, session=self.hub.session_id
+                )
+                self._compile_ledger.on_entry = self._note_program_cost
+                self.system.attach_compile_ledger(self._compile_ledger)
+            if cfg.observability.memory_watermarks:
+                from ..observability.memory import MemoryWatermarks
+
+                self._memory = MemoryWatermarks(
+                    cfg.observability.hbm_headroom_warn_frac
+                )
         # compiled-program variants already dispatched once: the first
         # dispatch of each variant pays its XLA compile, so its span (and
         # the settle that drains it) is tagged cold=True — obs_report and
@@ -367,6 +401,10 @@ class ExperimentRunner:
                     lambda: round(self._watchdog.beat_age_s(), 3),
                 )
             self.hub.add_provider("loader", self.loader.stats)
+            if self._compile_ledger is not None:
+                self.hub.add_provider("compile_ledger", self._compile_ledger.summary)
+            if self._memory is not None:
+                self.hub.add_provider("memory", self._memory.snapshot)
             if self.degraded_mesh is not None:
                 self.hub.registry.set_gauge("degraded_mesh", self.degraded_mesh)
 
@@ -392,6 +430,25 @@ class ExperimentRunner:
             return False
         self._variants_seen.add(key)
         return True
+
+    def _note_program_cost(self, entry: Dict[str, Any]) -> None:
+        """Compile-ledger observer: when the cost model prices a train
+        program, publish FLOPs per META-STEP as the gauge the live MFU
+        snapshot field reads (the multi-dispatch program scans K steps, so
+        its program FLOPs divide by K)."""
+        flops = entry.get("flops")
+        program = entry.get("program") or ""
+        if not flops:
+            return
+        if program.startswith("train_multi/"):
+            flops = flops / max(1, self.cfg.train_steps_per_dispatch)
+        elif not program.startswith("train/"):
+            return
+        self.hub.registry.set_gauge("flops_per_step", flops)
+        if entry.get("bytes_accessed"):
+            self.hub.registry.set_gauge(
+                "train_step_bytes_accessed", entry["bytes_accessed"]
+            )
 
     def _put(self, batch: Dict[str, np.ndarray], sharding=None):
         if self.mesh is not None:
@@ -430,12 +487,13 @@ class ExperimentRunner:
         # by restoring the state captured before it; the episode stream
         # moves on past the bad batch.
         guard = res.nan_guard
-        pending = None  # (state_before, loss_dev, acc_dev, forced_nan, cold, episodes)
+        # (state_before, loss_dev, acc_dev, forced_nan, cold, episodes, steps)
+        pending = None
 
         def settle() -> bool:
             """Judge the pending dispatch; True = good (stats recorded)."""
             nonlocal pending
-            state_before, loss_dev, acc_dev, forced, cold, episodes = pending
+            state_before, loss_dev, acc_dev, forced, cold, episodes, steps = pending
             pending = None
             # the settle phase spans the LAGGED fetch of dispatch i-1 while
             # dispatch i is already in flight — the pipeline's real
@@ -461,7 +519,7 @@ class ExperimentRunner:
             # CONSECUTIVE discards, not discards-since-last-rollback —
             # isolated NaNs hours apart must never add up to a rollback
             self._bad_steps = 0
-            self.hub.step_completed(episodes)
+            self.hub.step_completed(episodes, steps=steps)
             return True
 
         preempted = False
@@ -498,7 +556,7 @@ class ExperimentRunner:
                 if not guard:
                     losses.append(chunk_losses)
                     accs.append(chunk_accs)
-                    self.hub.step_completed(chunk_episodes)
+                    self.hub.step_completed(chunk_episodes, steps=K)
                     continue
                 if pending is not None and not settle():
                     # settle() restored the pre-poison state, which also
@@ -506,7 +564,7 @@ class ExperimentRunner:
                     self._note_bad_step(epoch)
                     continue
                 pending = (before, chunk_losses, chunk_accs, forced, cold,
-                           chunk_episodes)
+                           chunk_episodes, K)
         else:
             single_iters = total_iters
         if not preempted:
@@ -552,7 +610,7 @@ class ExperimentRunner:
                     self._note_bad_step(epoch)
                     continue
                 pending = (before, out.loss, out.accuracy, forced, cold,
-                           self.loader.batch_size)
+                           self.loader.batch_size, 1)
         # drain the lagged check (also before an emergency save: the saved
         # state must be a settled-good one)
         if pending is not None and not settle():
@@ -681,6 +739,11 @@ class ExperimentRunner:
             self.hub.close()
         except Exception:
             pass
+        if self._compile_ledger is not None:
+            try:
+                self._compile_ledger.close()
+            except Exception:
+                pass
         self.events.close()
 
     def _place_state(self, host_state: TrainState) -> TrainState:
@@ -1171,6 +1234,13 @@ class ExperimentRunner:
             # non-wedge exit path (telemetry.jsonl itself is flushed per
             # append, so the rc=76 os._exit only costs the trace file)
             self.hub.close()
+            if self._compile_ledger is not None:
+                try:
+                    self._compile_ledger.close()
+                except Exception:
+                    # a failing ledger close (full disk) must not skip the
+                    # events/loader closes below or mask the run's exception
+                    pass
             # flush + close events.jsonl on every non-wedge exit path
             # (normal, rc=3 abort, rc=75 preemption, errors); the rc=76
             # wedge path closes it itself before os._exit
@@ -1212,6 +1282,10 @@ class ExperimentRunner:
                 epoch=epoch,
                 train_wall_s=round(float(stats["epoch_run_time"]), 3),
             )
+            # HBM headroom check rides the epoch cadence: one latched
+            # hbm_headroom_low event per device before an OOM, never a flood
+            if self._memory is not None:
+                self._memory.maybe_warn(self.events)
             # a preemption signal that landed during eval/save: the epoch
             # checkpoint just written is complete, so exit restartable
             # without an extra emergency save

@@ -17,11 +17,27 @@ serving frontend instrument their hot paths through the same three pieces —
   step-phase histograms, provider snapshots: recompile guard, watchdog beat
   age, breaker state).
 
+The performance layer (ISSUE 7) builds on those three:
+
+- :mod:`costs` — robust XLA cost-model access (program FLOPs/bytes via a
+  never-raise fallback chain), the chip-peak table, and MFU arithmetic;
+- :mod:`compile_ledger` — every XLA compile timed (lower/compile split),
+  priced, and attributed to ``logs/compile_ledger.jsonl`` with
+  persistent-cache hit accounting (the AOT/cold-start evidence base);
+- :mod:`memory` — per-device HBM watermarks as a snapshot provider plus a
+  latched low-headroom event;
+- :mod:`slo` — deterministic open-loop load schedules + the SLO report
+  (CLI: ``scripts/loadgen.py``).
+
 Knobs: ``Config.observability`` (``config.py::ObservabilityConfig``) —
 fully inert and bit-identical when disabled. Report CLI:
-``scripts/obs_report.py``; howto: ``docs/OPERATIONS.md`` "Reading a run".
+``scripts/obs_report.py``; howto: ``docs/OPERATIONS.md`` "Reading a run"
+and "Performance triage".
 """
 
+from .compile_ledger import CompileLedger  # noqa: F401
+from .costs import jit_cost, mfu, peak_flops_per_sec, program_cost  # noqa: F401
+from .memory import MemoryWatermarks, device_memory_stats  # noqa: F401
 from .metrics import MetricsRegistry  # noqa: F401
 from .telemetry import NULL_HUB, TelemetryHub  # noqa: F401
 from .trace import (  # noqa: F401

@@ -1,0 +1,279 @@
+"""Per-program compile ledger: every XLA compile, priced and attributed.
+
+BENCH_r02's 37.9 s of compile+warmup is a single untracked number; ROADMAP
+item 2 (AOT compilation, seconds-not-minutes cold start) needs the evidence
+base: *which* programs cost what, how often they recompile, and whether the
+persistent cache actually absorbs them. The ledger records one JSON line per
+program build to ``logs/compile_ledger.jsonl``::
+
+    {"ts": ..., "program": "train/True/False", "lower_s": 0.41,
+     "compile_s": 6.2, "total_s": 6.61, "cold": true,
+     "persistent_cache": {"dir": ..., "entries_added": 1, "hit": false},
+     "flops": 1.2e9, "bytes_accessed": 3.4e7, "session": "..."}
+
+and aggregates in-process (:meth:`CompileLedger.summary` — a TelemetryHub
+provider), so ``scripts/obs_report.py`` can render the compile-tax table
+per run and ``/metrics`` can show it per serving replica.
+
+Hooked at the seams that already see every compile:
+
+- :meth:`wrap_build` wraps the jitted programs ``MAMLSystem`` /
+  ``AdaptationEngine`` build — the first call per argument signature runs
+  the explicit AOT split (``.lower()`` timed, ``.compile()`` timed, program
+  FLOPs read off the lowered/compiled pair via ``observability/costs.py``)
+  and later calls reuse the compiled executable. A *new* signature on the
+  same program is exactly an unplanned recompile — it gets its own timed
+  entry, which is the whole point. Any AOT failure degrades that signature
+  to the plain jitted call and records the error: the ledger must never be
+  able to take down a run.
+- ``RecompileGuard.wrap()`` (``utils/strictmode.py``) feeds first-call
+  timings for guard-wrapped functions through :meth:`record` (total only —
+  the guard has no lowered object to split or price).
+
+With no ``logs_dir`` the ledger is collector-only (serving frontends own no
+run dir; their summary rides ``/metrics`` and the hub provider instead).
+"""
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.compcache import active_cache_dir, cache_entry_count
+from .costs import program_cost
+
+
+def program_name(key: Any) -> str:
+    """Canonical ledger name for a program-cache key: tuples join with
+    ``/`` (``("train", True, False)`` -> ``"train/True/False"``)."""
+    if isinstance(key, (list, tuple)):
+        return "/".join(str(k) for k in key)
+    return str(key)
+
+
+class CompileLedger:
+    def __init__(
+        self,
+        logs_dir: Optional[str] = None,
+        session: Optional[str] = None,
+        clock: Callable[[], float] = time.perf_counter,
+        wall_clock: Callable[[], float] = time.time,
+    ):
+        self._clock = clock
+        self._wall_clock = wall_clock
+        self.session = session
+        self._lock = threading.Lock()
+        # program name -> aggregate {builds, lower_s, compile_s, total_s,
+        # cache_hits, errors, flops, bytes_accessed}
+        self._programs: Dict[str, Dict[str, Any]] = {}
+        self._entries = 0
+        self._log = None
+        if logs_dir is not None:
+            from ..experiment.storage import EventLog
+
+            self._log = EventLog(logs_dir, filename="compile_ledger.jsonl")
+        #: optional observer called with each entry dict AFTER it is
+        #: recorded (the runner uses it to set the flops_per_step gauge the
+        #: live MFU computation reads). Observer errors are contained.
+        self.on_entry: Optional[Callable[[Dict[str, Any]], None]] = None
+
+    # ------------------------------------------------------------------
+
+    def record(
+        self,
+        program: Any,
+        lower_s: Optional[float] = None,
+        compile_s: Optional[float] = None,
+        total_s: Optional[float] = None,
+        cold: bool = True,
+        persistent_cache: Optional[Dict[str, Any]] = None,
+        flops: Optional[float] = None,
+        bytes_accessed: Optional[float] = None,
+        error: Optional[str] = None,
+        **extra: Any,
+    ) -> Dict[str, Any]:
+        """Append one ledger entry (and fold it into the in-process
+        aggregate). Never raises."""
+        name = program_name(program)
+        if total_s is None and (lower_s is not None or compile_s is not None):
+            total_s = (lower_s or 0.0) + (compile_s or 0.0)
+        entry: Dict[str, Any] = {
+            "ts": self._wall_clock(),
+            "program": name,
+            "lower_s": round(lower_s, 4) if lower_s is not None else None,
+            "compile_s": round(compile_s, 4) if compile_s is not None else None,
+            "total_s": round(total_s, 4) if total_s is not None else None,
+            "cold": bool(cold),
+            "persistent_cache": persistent_cache,
+            "flops": flops,
+            "bytes_accessed": bytes_accessed,
+            "error": error,
+        }
+        if self.session is not None:
+            entry["session"] = self.session
+        entry.update(extra)
+        with self._lock:
+            self._entries += 1
+            agg = self._programs.setdefault(
+                name,
+                {
+                    "builds": 0,
+                    "lower_s": 0.0,
+                    "compile_s": 0.0,
+                    "total_s": 0.0,
+                    "cache_hits": 0,
+                    "errors": 0,
+                    "flops": None,
+                    "bytes_accessed": None,
+                },
+            )
+            agg["builds"] += 1
+            agg["lower_s"] = round(agg["lower_s"] + (lower_s or 0.0), 4)
+            agg["compile_s"] = round(agg["compile_s"] + (compile_s or 0.0), 4)
+            agg["total_s"] = round(agg["total_s"] + (total_s or 0.0), 4)
+            if persistent_cache and persistent_cache.get("hit"):
+                agg["cache_hits"] += 1
+            if error is not None:
+                agg["errors"] += 1
+            if flops is not None:
+                agg["flops"] = flops
+            if bytes_accessed is not None:
+                agg["bytes_accessed"] = bytes_accessed
+        if self._log is not None:
+            try:
+                self._log.append(entry)
+            except Exception:
+                pass  # a full disk must not turn a compile into a crash
+        observer = self.on_entry
+        if observer is not None:
+            try:
+                observer(entry)
+            except Exception:
+                pass
+        return entry
+
+    def summary(self) -> Dict[str, Any]:
+        """The compile-tax aggregate (TelemetryHub provider / ``/metrics``
+        payload): totals plus the per-program table."""
+        with self._lock:
+            programs = {k: dict(v) for k, v in self._programs.items()}
+            entries = self._entries
+        return {
+            "entries": entries,
+            "programs": len(programs),
+            "total_lower_s": round(sum(p["lower_s"] for p in programs.values()), 3),
+            "total_compile_s": round(sum(p["compile_s"] for p in programs.values()), 3),
+            "total_s": round(sum(p["total_s"] for p in programs.values()), 3),
+            "cache_hits": sum(p["cache_hits"] for p in programs.values()),
+            "errors": sum(p["errors"] for p in programs.values()),
+            "by_program": programs,
+        }
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()
+
+    # ------------------------------------------------------------------
+    # the build seam
+    # ------------------------------------------------------------------
+
+    def wrap_build(self, program: Any, jitted_fn: Callable) -> "LedgerWrapped":
+        """Wrap a freshly-built jitted callable so every compile it pays is
+        a timed, priced ledger entry. Call this where the program cache
+        inserts a new entry (``MAMLSystem._compiled_train_step`` and
+        friends)."""
+        return LedgerWrapped(self, program_name(program), jitted_fn)
+
+
+class LedgerWrapped:
+    """A jitted callable whose compiles go through the ledger.
+
+    First call per argument signature: explicit AOT — ``lower`` (timed),
+    ``compile`` (timed), program cost off the lowered/compiled pair, one
+    ledger entry — then the compiled executable is cached per signature and
+    every later call dispatches through it, preserving jit's
+    recompile-on-new-shape semantics (a new signature builds, times, and
+    records again: that recompile is precisely what the ledger exists to
+    see). AOT failure for a signature records the error and pins that
+    signature to the plain jitted call."""
+
+    def __init__(self, ledger: CompileLedger, program: str, jitted_fn: Callable):
+        self._ledger = ledger
+        self.program = program
+        self._jitted = jitted_fn
+        self._lock = threading.Lock()
+        self._by_sig: Dict[Any, Callable] = {}
+        self._clock = ledger._clock
+
+    def lower(self, *args, **kwargs):
+        """Delegate so AOT consumers (bench's cost probe) keep working."""
+        return self._jitted.lower(*args, **kwargs)
+
+    def _signature(self, args, kwargs) -> Any:
+        from ..utils.strictmode import abstract_signature
+
+        try:
+            return abstract_signature((args, tuple(sorted(kwargs.items()))))
+        except Exception:
+            return ("unsigned",)
+
+    def _build(self, sig: Any, args, kwargs) -> Callable:
+        clock = self._clock
+        cache_dir = active_cache_dir()
+        entries_before = cache_entry_count(cache_dir)
+        try:
+            t0 = clock()
+            lowered = self._jitted.lower(*args, **kwargs)
+            t1 = clock()
+            compiled = lowered.compile()
+            t2 = clock()
+        except Exception as exc:
+            self._ledger.record(
+                self.program,
+                cold=True,
+                error=f"aot build failed: {type(exc).__name__}: {exc}",
+            )
+            return self._jitted
+        entries_after = cache_entry_count(cache_dir)
+        cache_info: Optional[Dict[str, Any]] = None
+        if entries_before is not None and entries_after is not None:
+            added = entries_after - entries_before
+            # no new entry on a live cache dir = the compile was served from
+            # it (or fell below the cache's size/time thresholds — the raw
+            # delta stays in the record so that ambiguity is visible)
+            cache_info = {"dir": cache_dir, "entries_added": added, "hit": added == 0}
+        cost = program_cost(lowered, compiled)
+        self._ledger.record(
+            self.program,
+            lower_s=t1 - t0,
+            compile_s=t2 - t1,
+            cold=not (cache_info or {}).get("hit", False),
+            persistent_cache=cache_info,
+            flops=cost.get("flops"),
+            bytes_accessed=cost.get("bytes_accessed"),
+        )
+        return compiled
+
+    def __call__(self, *args, **kwargs):
+        # steady-state fast path: with exactly one signature built (the
+        # overwhelmingly common case — train steps and bucketed serving
+        # programs are shape-stable), dispatch straight into its compiled
+        # executable. A Compiled validates its own input signature and
+        # raises TypeError on mismatch, so a new shape still falls through
+        # to the slow path below — no per-call pytree walk, no lock on the
+        # hot path. (After an AOT-build failure the cached fn is the plain
+        # jitted callable, which handles any signature itself.)
+        by_sig = self._by_sig
+        if len(by_sig) == 1:
+            try:
+                return next(iter(by_sig.values()))(*args, **kwargs)
+            except TypeError:
+                pass  # new signature (or a caller error the rebuild surfaces)
+        sig = self._signature(args, kwargs)
+        with self._lock:
+            fn = self._by_sig.get(sig)
+            if fn is None:
+                # build under the lock: concurrent first calls of one
+                # signature must pay (and record) exactly one compile
+                fn = self._build(sig, args, kwargs)
+                self._by_sig[sig] = fn
+        return fn(*args, **kwargs)

@@ -1,0 +1,140 @@
+"""Per-device HBM watermarks: live/peak bytes-in-use, limit, headroom.
+
+OOMs on the chip are post-hoc mysteries today: nothing records how close a
+run sat to the HBM limit before it died. ``MemoryWatermarks`` reads
+``device.memory_stats()`` (the PJRT allocator's own counters) into a
+telemetry-provider snapshot — embedded in every ``telemetry.jsonl`` record
+when observability is on — plus an optional one-shot low-headroom event per
+device, so a run that is *about* to OOM says so in ``events.jsonl`` while
+it can still speak.
+
+``memory_stats()`` availability varies by platform (older CPU backends
+return None, some plugins raise); every path degrades to an explicit
+``{"available": false, "reason": ...}`` row rather than raising — memory
+telemetry must never be able to kill the run it watches.
+"""
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _device_rows(devices) -> List[Dict[str, Any]]:
+    rows: List[Dict[str, Any]] = []
+    for d in devices:
+        row: Dict[str, Any] = {
+            "device": int(getattr(d, "id", len(rows))),
+            "kind": str(getattr(d, "device_kind", "?")),
+        }
+        try:
+            stats = d.memory_stats()
+        except Exception as exc:
+            row.update({"available": False, "reason": f"{type(exc).__name__}: {exc}"})
+            rows.append(row)
+            continue
+        if not stats:
+            row.update({"available": False, "reason": "memory_stats() returned none"})
+            rows.append(row)
+            continue
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use")
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        row.update(
+            {
+                "available": True,
+                "bytes_in_use": in_use,
+                "peak_bytes_in_use": peak,
+                "bytes_limit": limit,
+                "headroom_frac": (
+                    round((limit - in_use) / limit, 4)
+                    if limit and in_use is not None
+                    else None
+                ),
+            }
+        )
+        rows.append(row)
+    return rows
+
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """One row per local device; never raises (an unreachable backend
+    yields a single unavailable row)."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception as exc:
+        return [
+            {
+                "device": -1,
+                "kind": "?",
+                "available": False,
+                "reason": f"{type(exc).__name__}: {exc}",
+            }
+        ]
+    return _device_rows(devices)
+
+
+class MemoryWatermarks:
+    """TelemetryHub provider + low-headroom event latch.
+
+    ``snapshot()`` (the provider) returns the per-device rows plus the
+    fleet-level aggregates readers actually key on (max peak, min
+    headroom). ``maybe_warn(event_log)`` appends one ``hbm_headroom_low``
+    event per device the first time its headroom drops below
+    ``warn_headroom_frac`` — latched, so a run hovering at the threshold
+    doesn't flood events.jsonl. ``stats_fn`` is injectable for tests."""
+
+    def __init__(
+        self,
+        warn_headroom_frac: float = 0.05,
+        stats_fn: Callable[[], List[Dict[str, Any]]] = device_memory_stats,
+    ):
+        self.warn_headroom_frac = float(warn_headroom_frac)
+        self._stats_fn = stats_fn
+        self._warned: set = set()
+
+    def snapshot(self) -> Dict[str, Any]:
+        rows = self._stats_fn()
+        live = [r for r in rows if r.get("available")]
+        peaks = [r["peak_bytes_in_use"] for r in live if r.get("peak_bytes_in_use")]
+        headrooms = [
+            r["headroom_frac"] for r in live if r.get("headroom_frac") is not None
+        ]
+        return {
+            "devices": rows,
+            "available_devices": len(live),
+            "peak_bytes_in_use_max": max(peaks) if peaks else None,
+            "headroom_frac_min": min(headrooms) if headrooms else None,
+        }
+
+    def maybe_warn(self, event_log=None) -> List[Dict[str, Any]]:
+        """Check headroom against the threshold; returns (and appends to
+        ``event_log`` when given) the newly-fired events. Never raises."""
+        fired: List[Dict[str, Any]] = []
+        try:
+            for row in self._stats_fn():
+                headroom = row.get("headroom_frac")
+                dev = row.get("device")
+                if (
+                    headroom is None
+                    or dev in self._warned
+                    or headroom >= self.warn_headroom_frac
+                ):
+                    continue
+                self._warned.add(dev)
+                event = {
+                    "ts": time.time(),
+                    "event": "hbm_headroom_low",
+                    "device": dev,
+                    "kind": row.get("kind"),
+                    "headroom_frac": headroom,
+                    "bytes_in_use": row.get("bytes_in_use"),
+                    "bytes_limit": row.get("bytes_limit"),
+                    "threshold": self.warn_headroom_frac,
+                }
+                fired.append(event)
+                if event_log is not None:
+                    event_log.append(event)
+        except Exception:
+            pass
+        return fired

@@ -1,0 +1,390 @@
+"""Open-loop SLO load harness: seeded schedules, stairs, one-line reports.
+
+ROADMAP item 1's acceptance — "handles N req/s within SLO" — needs three
+pieces this module owns:
+
+- :func:`generate_schedule` — a **deterministic** open-loop request
+  schedule: heavy-tailed (lognormal) inter-arrivals over an offered-load
+  staircase, mixed adapt/predict traffic, bucket-skewed query sizes. Same
+  seed, same arguments => bit-identical schedule (test-pinned), so two load
+  tests across a code change offer *exactly* the same traffic.
+- :func:`run_load` — drive a live ``ServingFrontend`` (in-process; the HTTP
+  layer adds a constant that says nothing about the engine) open-loop:
+  requests launch at their scheduled offsets whether or not earlier ones
+  returned — the harness never self-throttles onto the backend's rhythm,
+  which is exactly the closed-loop mistake that hides queueing collapse.
+- :func:`slo_report` — the one-JSON-line verdict in the same BENCH-line
+  contract as ``bench_serving.py``: per-stair p50/p99 vs offered load, shed
+  rate, 503/504 counts, breaker trips; headline = the highest offered load
+  whose stair met the SLO.
+
+Outcome taxonomy matches the frontend's failure modes: ``ok``, ``shed``
+(``ServiceUnavailableError`` — queue full or breaker open; HTTP 503),
+``deadline`` (``DeadlineExceededError``; HTTP 504), ``error`` (anything
+else). CLI: ``scripts/loadgen.py``.
+"""
+
+import concurrent.futures
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+#: heavy-tail shape for inter-arrivals: lognormal sigma. 1.0 gives a burst
+#: profile where ~10% of gaps are >2.5x the mean — enough to exercise the
+#: queue/shed machinery without degenerating into one mega-burst.
+DEFAULT_TAIL_SIGMA = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One scheduled request. ``t`` is seconds from test start; ``episode_seed``
+    determines the payload (support/query content) deterministically."""
+
+    t: float
+    kind: str  # "adapt" | "predict"
+    episode_seed: int
+    n_query: int
+    stair: int  # index into the offered-load staircase
+
+
+def generate_schedule(
+    seed: int,
+    duration_s: float,
+    stairs_rps: Sequence[float],
+    adapt_frac: float = 0.25,
+    query_sizes: Sequence[int] = (5, 15, 40),
+    query_weights: Sequence[float] = (0.7, 0.2, 0.1),
+    tail_sigma: float = DEFAULT_TAIL_SIGMA,
+) -> List[Request]:
+    """Deterministic open-loop schedule: ``duration_s`` split evenly across
+    ``stairs_rps`` offered-load stages; within a stage, inter-arrivals are
+    lognormal with mean ``1/rps`` (heavy-tailed: sigma in log space), kinds
+    drawn ``adapt`` with probability ``adapt_frac``, query sizes skewed by
+    ``query_weights`` (the bucket-skew knob: most traffic hits the small
+    buckets, a tail hits the big ones)."""
+    if not stairs_rps:
+        raise ValueError("stairs_rps must name at least one offered load")
+    if duration_s <= 0:
+        raise ValueError(f"duration_s must be > 0, got {duration_s}")
+    weights = np.asarray(query_weights, np.float64)
+    weights = weights / weights.sum()
+    rng = np.random.default_rng(int(seed))
+    per_stair = float(duration_s) / len(stairs_rps)
+    schedule: List[Request] = []
+    for stair, rps in enumerate(stairs_rps):
+        if rps <= 0:
+            raise ValueError(f"offered load must be > 0 req/s, got {rps}")
+        t = stair * per_stair
+        end = (stair + 1) * per_stair
+        # lognormal with mean 1/rps: mu = ln(mean) - sigma^2/2
+        mu = np.log(1.0 / float(rps)) - tail_sigma**2 / 2.0
+        while True:
+            t += float(rng.lognormal(mu, tail_sigma))
+            if t >= end:
+                break
+            schedule.append(
+                Request(
+                    t=round(t, 6),
+                    kind="adapt" if rng.random() < adapt_frac else "predict",
+                    episode_seed=int(rng.integers(0, 2**31)),
+                    n_query=int(query_sizes[int(rng.choice(len(weights), p=weights))]),
+                    stair=stair,
+                )
+            )
+    return schedule
+
+
+def schedule_digest(schedule: List[Request]) -> Dict[str, Any]:
+    """Compact, JSON-able fingerprint of a schedule (the determinism
+    contract surface: two same-seed generators must produce identical
+    digests AND identical entry lists)."""
+    return {
+        "n": len(schedule),
+        "kinds": {
+            k: sum(1 for r in schedule if r.kind == k) for k in ("adapt", "predict")
+        },
+        "per_stair": [
+            sum(1 for r in schedule if r.stair == s)
+            for s in range(max((r.stair for r in schedule), default=-1) + 1)
+        ],
+        "first_t": schedule[0].t if schedule else None,
+        "last_t": schedule[-1].t if schedule else None,
+    }
+
+
+class _Results:
+    """Thread-safe per-request outcome recorder (worker threads land their
+    verdicts here; aggregation happens after the run)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows: List[Dict[str, Any]] = []
+
+    def add(self, stair: int, kind: str, outcome: str, latency_ms: float) -> None:
+        with self._lock:
+            self._rows.append(
+                {
+                    "stair": stair,
+                    "kind": kind,
+                    "outcome": outcome,
+                    "latency_ms": latency_ms,
+                }
+            )
+
+    def rows(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._rows)
+
+
+def _batch_buckets(max_batch: int) -> List[int]:
+    """The batch sizes ``engine._batch_bucket`` can round a flush up to:
+    powers of two capped at ``max_batch``, plus ``max_batch`` itself."""
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return sorted(set(out))
+
+
+def _warm_batch_buckets(frontend, schedule, make_support, make_query, log) -> None:
+    """Compile the (bucket x batch-bucket) grid batched flushes will hit:
+    under concurrency the frontend's MicroBatcher dispatches task-batches,
+    so the single-request warmup alone leaves every ``serve_*/(bucket,
+    b>1)`` program cold — and its first mid-stair compile would bill XLA
+    seconds to that stair's p99, the exact poisoning warmup exists to
+    prevent. Degrades to a logged skip on frontends without an engine
+    (test doubles) — the single-request warmup already ran."""
+    engine = getattr(frontend, "engine", None)
+    if engine is None:
+        log("loadgen: batch-bucket warmup skipped (frontend has no engine)")
+        return
+    try:
+        buckets = [b for b in _batch_buckets(engine.serving.max_batch_size) if b > 1]
+        x_s, y_s = make_support(-1)
+        fw = engine.adapt(x_s, y_s)
+        for b in buckets:
+            engine.adapt_batch([(x_s, y_s)] * b)
+        for n_query in sorted({r.n_query for r in schedule}):
+            q = make_query(-1, n_query)
+            for b in buckets:
+                engine.predict_batch([(fw, q)] * b)
+    except Exception as exc:  # noqa: BLE001 — warmup must not kill the test
+        log(
+            "loadgen: batch-bucket warmup failed (continuing): "
+            f"{type(exc).__name__}: {exc}"
+        )
+
+
+def run_load(
+    frontend,
+    schedule: List[Request],
+    make_support: Callable[[int], Any],
+    make_query: Callable[[int, int], Any],
+    warm_adaptations: int = 2,
+    max_workers: int = 16,
+    result_grace_s: float = 60.0,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+    log: Callable[[str], None] = lambda m: None,
+) -> Dict[str, Any]:
+    """Drive ``frontend`` through ``schedule`` open-loop and return the raw
+    outcome rows + breaker delta.
+
+    ``make_support(episode_seed) -> (x_support, y_support)`` and
+    ``make_query(episode_seed, n_query) -> x_query`` build payloads — kept
+    injectable so this module never imports the data stack. Warmup
+    (``warm_adaptations`` adapt calls + one predict per distinct query size
+    in the schedule, compiling every serving program the traffic will hit
+    and seeding the adaptation-id pool predict traffic draws from) runs
+    before the clock starts and is excluded from every number.
+
+    Latencies are measured from each request's SCHEDULED arrival, not from
+    worker pickup: when the backend (or the harness's own ``max_workers``
+    in-flight cap) falls behind, the queue wait lands in the measured
+    latency instead of being coordinated-omitted — the open-loop point."""
+    if not schedule:
+        raise ValueError("schedule is empty — lengthen duration_s or raise stairs_rps")
+    results = _Results()
+    ids: List[str] = []
+    ids_lock = threading.Lock()
+
+    # -- warmup: compile + seed the adaptation pool (excluded). One predict
+    # per distinct scheduled query size: a cold bucket compile inside a
+    # measured stair would bill seconds of XLA time to that stair's p99.
+    for i in range(max(warm_adaptations, 1)):
+        x_s, y_s = make_support(-(i + 1))
+        info = frontend.adapt(x_s, y_s)
+        with ids_lock:
+            ids.append(info["adaptation_id"])
+    for n_query in sorted({r.n_query for r in schedule}):
+        frontend.predict(ids[0], make_query(-1, n_query))
+    _warm_batch_buckets(frontend, schedule, make_support, make_query, log)
+    log(f"loadgen: warm ({len(ids)} adaptations cached)")
+    breaker_before = frontend.breaker.snapshot()
+
+    from ..resilience.retry import DeadlineExceededError
+    from ..serving.server import ServiceUnavailableError
+
+    def one(req: Request, sched_t: float) -> None:
+        try:
+            if req.kind == "adapt":
+                x_s, y_s = make_support(req.episode_seed)
+                info = frontend.adapt(x_s, y_s)
+                with ids_lock:
+                    ids.append(info["adaptation_id"])
+                outcome = "ok"
+            else:
+                with ids_lock:
+                    aid = ids[req.episode_seed % len(ids)]
+                frontend.predict(aid, make_query(req.episode_seed, req.n_query))
+                outcome = "ok"
+        except ServiceUnavailableError:
+            outcome = "shed"
+        except DeadlineExceededError:
+            outcome = "deadline"
+        except Exception as exc:  # noqa: BLE001 — the report carries the count
+            log(f"loadgen: request error: {type(exc).__name__}: {exc}")
+            outcome = "error"
+        results.add(req.stair, req.kind, outcome, round((clock() - sched_t) * 1e3, 3))
+
+    # -- open loop: launch at schedule time, never wait for completions --
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_workers)
+    futures = []
+    unresolved_by_stair: Dict[int, int] = {}
+    start = clock()
+    try:
+        for req in schedule:
+            delay = req.t - (clock() - start)
+            if delay > 0:
+                sleep(delay)
+            futures.append(pool.submit(one, req, start + req.t))
+        # one shared grace window past the end of the schedule: a request a
+        # wedged backend never answers (exactly what a load test exists to
+        # surface) costs at most the grace and an ``unresolved`` count in
+        # the report — never the report itself
+        grace_deadline = time.monotonic() + result_grace_s
+        for req, fut in zip(schedule, futures):
+            try:
+                fut.result(timeout=max(0.0, grace_deadline - time.monotonic()))
+            except concurrent.futures.TimeoutError:
+                unresolved_by_stair[req.stair] = (
+                    unresolved_by_stair.get(req.stair, 0) + 1
+                )
+    finally:
+        pool.shutdown(wait=False)
+    wall_s = clock() - start
+    unresolved = sum(unresolved_by_stair.values())
+    if unresolved:
+        log(f"loadgen: {unresolved} requests unresolved after {result_grace_s}s grace")
+    breaker_after = frontend.breaker.snapshot()
+    return {
+        "rows": results.rows(),
+        "unresolved_by_stair": unresolved_by_stair,
+        "unresolved": unresolved,
+        "wall_s": round(wall_s, 3),
+        "breaker_trips": int(breaker_after.get("opens", 0))
+        - int(breaker_before.get("opens", 0)),
+        "breaker": breaker_after,
+    }
+
+
+def _percentiles(latencies: List[float]) -> Dict[str, Optional[float]]:
+    if not latencies:
+        return {"p50_ms": None, "p99_ms": None}
+    arr = np.asarray(latencies, np.float64)
+    p50, p99 = np.percentile(arr, [50, 99])
+    return {"p50_ms": round(float(p50), 3), "p99_ms": round(float(p99), 3)}
+
+
+def slo_report(
+    schedule: List[Request],
+    run: Dict[str, Any],
+    stairs_rps: Sequence[float],
+    duration_s: float,
+    seed: int,
+    slo_p99_ms: float,
+    max_shed_rate: float,
+    metric_suffix: str = "",
+    platform: Optional[str] = None,
+    **extra: Any,
+) -> Dict[str, Any]:
+    """Aggregate raw outcomes into the one-JSON-line SLO report (BENCH-line
+    contract: ``metric``/``value``/``unit``/``vs_baseline`` + diagnostics).
+    Headline value = the highest offered load (req/s) whose stair met the
+    SLO (p99 <= ``slo_p99_ms`` on completed requests AND shed+error rate <=
+    ``max_shed_rate``); None when no stair qualified."""
+    rows = run["rows"]
+    unresolved_by_stair = run.get("unresolved_by_stair") or {}
+    per_stair_s = float(duration_s) / len(stairs_rps)
+    stairs: List[Dict[str, Any]] = []
+    sustained: Optional[float] = None
+    for idx, rps in enumerate(stairs_rps):
+        mine = [r for r in rows if r["stair"] == idx]
+        offered = [r for r in schedule if r.stair == idx]
+        counts = {
+            k: sum(1 for r in mine if r["outcome"] == k)
+            for k in ("ok", "shed", "deadline", "error")
+        }
+        unresolved = int(unresolved_by_stair.get(idx, 0))
+        n = len(mine)
+        ok_lat = [r["latency_ms"] for r in mine if r["outcome"] == "ok"]
+        shed_rate = (counts["shed"] + counts["error"]) / n if n else None
+        pcts = _percentiles(ok_lat)
+        # an unresolved request outlived the whole grace window — worse
+        # than a deadline miss, so it disqualifies the stair outright
+        met = (
+            n > 0
+            and counts["ok"] > 0
+            and counts["deadline"] == 0
+            and unresolved == 0
+            and shed_rate is not None
+            and shed_rate <= max_shed_rate
+            and pcts["p99_ms"] is not None
+            and pcts["p99_ms"] <= slo_p99_ms
+        )
+        if met and (sustained is None or rps > sustained):
+            sustained = float(rps)
+        stairs.append(
+            {
+                "offered_rps": float(rps),
+                "achieved_rps": round(counts["ok"] / per_stair_s, 3),
+                "n_offered": len(offered),
+                **counts,
+                "unresolved": unresolved,
+                "shed_rate": round(shed_rate, 4) if shed_rate is not None else None,
+                **pcts,
+                "slo_met": met,
+            }
+        )
+    totals = {
+        k: sum(s[k] for s in stairs) for k in ("ok", "shed", "deadline", "error")
+    }
+    n_total = sum(totals.values())
+    total_unresolved = sum(s["unresolved"] for s in stairs)
+    report = {
+        "metric": f"serving_slo_sustained_rps{metric_suffix}",
+        "value": sustained,
+        "unit": "req/s within SLO",
+        "vs_baseline": None,  # no reference serving path to compare against
+        "platform": platform,
+        "seed": int(seed),
+        "duration_s": float(duration_s),
+        "slo_p99_ms": float(slo_p99_ms),
+        "max_shed_rate": float(max_shed_rate),
+        "requests": n_total + total_unresolved,
+        **totals,
+        "unresolved": total_unresolved,
+        "shed_rate": (
+            round((totals["shed"] + totals["error"]) / n_total, 4) if n_total else None
+        ),
+        "breaker_trips": run["breaker_trips"],
+        "stairs": stairs,
+        "wall_s": run["wall_s"],
+    }
+    report.update(extra)
+    return report

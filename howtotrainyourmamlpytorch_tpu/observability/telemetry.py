@@ -133,6 +133,8 @@ class TelemetryHub:
         self._episodes = 0
         self._last_snap_t = self._t_start
         self._last_snap_episodes = 0
+        self._last_snap_steps = 0
+        self._next_snap_step = self.snapshot_every_steps
         self._closed = False
 
     @classmethod
@@ -170,17 +172,20 @@ class TelemetryHub:
         if self.enabled:
             self._providers[name] = fn
 
-    def step_completed(self, episodes: int = 0) -> None:
-        """One settled train step (``episodes`` = meta-batch episodes it
-        carried). Drives the per-N-step snapshot cadence."""
+    def step_completed(self, episodes: int = 0, steps: int = 1) -> None:
+        """One settled dispatch (``episodes`` = meta-batch episodes it
+        carried, ``steps`` = meta-steps it ran — K for a multi-step
+        dispatch, so ``interval_steps_per_s`` and the MFU it feeds stay in
+        meta-steps regardless of dispatch chunking). Drives the per-N-step
+        snapshot cadence."""
         if not self.enabled:
             return
-        self._steps += 1
+        self._steps += steps
         self._episodes += episodes
-        if (
-            self.snapshot_every_steps > 0
-            and self._steps % self.snapshot_every_steps == 0
-        ):
+        # crossing check, not modulo: a K-step jump must not hop over the
+        # cadence boundary
+        if self.snapshot_every_steps > 0 and self._steps >= self._next_snap_step:
+            self._next_snap_step = self._steps + self.snapshot_every_steps
             self.snapshot("step")
 
     # -- snapshots -----------------------------------------------------
@@ -203,8 +208,10 @@ class TelemetryHub:
         now = self._clock()
         interval_s = now - self._last_snap_t
         interval_eps = self._episodes - self._last_snap_episodes
+        interval_steps = self._steps - self._last_snap_steps
         self._last_snap_t = now
         self._last_snap_episodes = self._episodes
+        self._last_snap_steps = self._steps
         elapsed = now - self._t_start
         record: Dict[str, Any] = {
             "ts": self._wall_clock(),
@@ -217,12 +224,33 @@ class TelemetryHub:
             "interval_episodes_per_s": (
                 round(interval_eps / interval_s, 3) if interval_s > 0 else None
             ),
+            "interval_steps_per_s": (
+                round(interval_steps / interval_s, 3) if interval_s > 0 else None
+            ),
             "phases": self.registry.summaries(PHASE_PREFIX),
             "counters": self.registry.counters(),
             "gauges": self.registry.gauges(),
             "providers": self._provider_values(),
             "dropped_spans": getattr(self.tracer, "dropped", 0),
         }
+        # live MFU: the flops_per_step gauge (set by the runner's compile-
+        # ledger observer once the cost model prices the train program)
+        # times the interval step rate over the chip-peak gauge. Null with
+        # the gauges absent — notably peak on CPU, where the reason rides
+        # the mfu_unavailable_reason gauge instead.
+        fps = self.registry.gauge("flops_per_step")
+        peak = self.registry.gauge("peak_flops_per_sec")
+        steps_ps = record["interval_steps_per_s"]
+        if steps_ps is None:
+            steps_ps = round(self._steps / elapsed, 3) if elapsed > 0 else None
+        if fps:
+            # a zero-step interval (eval/checkpoint-dominated snapshot) is
+            # honestly mfu=0.0, not a fall-back to the lifetime average
+            record["mfu"] = (
+                round(fps * steps_ps / peak, 5)
+                if peak and steps_ps is not None
+                else None
+            )
         record.update(extra)
         if self._log is not None:
             self._log.append(record)

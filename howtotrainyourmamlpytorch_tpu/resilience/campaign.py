@@ -407,13 +407,14 @@ def child_train_main(cfg_yaml: str) -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # site-hook override guard
-    # mirror conftest's persistent-cache tuning so tiny programs cache too
-    jax.config.update(
-        "jax_compilation_cache_dir",
+    # shared persistent-cache setup, with the conftest test tuning so the
+    # campaign's tiny programs cache too (utils/compcache.py)
+    from ..utils.compcache import setup_compilation_cache
+
+    setup_compilation_cache(
         os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache"),
+        test_tuning=True,
     )
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
     from ..config import load_config
 

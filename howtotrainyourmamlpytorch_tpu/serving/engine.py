@@ -71,6 +71,7 @@ class AdaptationEngine:
         injector=None,
         strict: Optional[bool] = None,
         tracer=None,
+        compile_ledger=None,
     ):
         self.system = system
         self.cfg = system.cfg
@@ -110,6 +111,11 @@ class AdaptationEngine:
         self._adapt_jit: Dict[Tuple[int, int], Any] = {}
         self._predict_jit: Dict[Tuple[int, int], Any] = {}
         self._jit_lock = threading.Lock()
+        # compile ledger (observability/compile_ledger.py): when set (ctor
+        # param, or attribute assignment before the first request — the
+        # ServingFrontend attaches a collector-only ledger when telemetry
+        # is on), every bucket program's compile is timed and priced
+        self.compile_ledger = compile_ledger
         # strict mode (Config.strict_recompile_guard / explicit ``strict=``):
         # the bucket tables declare the whole program family up front; a
         # request that would compile outside it (an oversize support/query
@@ -170,7 +176,10 @@ class AdaptationEngine:
                         )
                     )(xs, ys, ws)
 
-                fn = self._adapt_jit[key] = jax.jit(adapt_batched)
+                fn = jax.jit(adapt_batched)
+                if self.compile_ledger is not None:
+                    fn = self.compile_ledger.wrap_build(("serve_adapt",) + key, fn)
+                self._adapt_jit[key] = fn
         return fn
 
     def _compiled_predict(self, query_size: int, batch: int):
@@ -188,7 +197,10 @@ class AdaptationEngine:
                     )(fw, xs, ws)
                     return jax.nn.softmax(logits, axis=-1)
 
-                fn = self._predict_jit[key] = jax.jit(predict_batched)
+                fn = jax.jit(predict_batched)
+                if self.compile_ledger is not None:
+                    fn = self.compile_ledger.wrap_build(("serve_predict",) + key, fn)
+                self._predict_jit[key] = fn
         return fn
 
     def compile_counts(self) -> Dict[str, Any]:
@@ -199,6 +211,8 @@ class AdaptationEngine:
             }
         if self.recompile_guard is not None:
             out["recompile_guard"] = self.recompile_guard.snapshot()
+        if self.compile_ledger is not None:
+            out["compile_ledger"] = self.compile_ledger.summary()
         return out
 
     # ------------------------------------------------------------------

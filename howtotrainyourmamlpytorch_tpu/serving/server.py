@@ -91,6 +91,7 @@ class ServingFrontend:
             self.serving.latency_window, registry=self.hub.registry
         )
         self.counters = EventCounters(registry=self.hub.registry)
+        self._memory = None
         if self.hub.enabled:
             # trace the engine's device dispatches and both batchers' flushes
             # through the hub's tracer (engines built standalone keep their
@@ -98,6 +99,30 @@ class ServingFrontend:
             if self.engine.tracer is NULL_TRACER:
                 self.engine.tracer = self.hub.tracer
             self.hub.add_provider("breaker", lambda: self.breaker.snapshot())
+            obs_cfg = getattr(engine.cfg, "observability", None)
+            # collector-only compile ledger (a server owns no run dir): the
+            # per-bucket program compiles show up in /metrics.compiled and
+            # in every hub snapshot, so the serving cold-start tax is a
+            # number, not a vibe
+            if self.engine.compile_ledger is None and getattr(
+                obs_cfg, "compile_ledger", True
+            ):
+                from ..observability.compile_ledger import CompileLedger
+
+                self.engine.compile_ledger = CompileLedger(
+                    session=self.hub.session_id
+                )
+            if self.engine.compile_ledger is not None:
+                self.hub.add_provider(
+                    "compile_ledger", self.engine.compile_ledger.summary
+                )
+            if getattr(obs_cfg, "memory_watermarks", True):
+                from ..observability.memory import MemoryWatermarks
+
+                self._memory = MemoryWatermarks(
+                    getattr(obs_cfg, "hbm_headroom_warn_frac", 0.05)
+                )
+                self.hub.add_provider("memory", self._memory.snapshot)
         self.breaker = CircuitBreaker(
             failure_threshold=self.resilience.breaker_failure_threshold,
             cooldown_s=self.resilience.breaker_cooldown_s,

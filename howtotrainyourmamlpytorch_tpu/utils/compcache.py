@@ -1,0 +1,76 @@
+"""Single source for the JAX persistent-compilation-cache setup.
+
+Every entry point used to copy-paste the same four lines (env check +
+``jax.config.update("jax_compilation_cache_dir", ...)`` + test tuning) —
+``train_maml_system.py``, ``bench.py``, ``scripts/chaos_soak.py``,
+``scripts/stream_replay_probe.py``, ``resilience/campaign.py`` — each with
+its own default. One drifting copy means one entry point silently paying
+full XLA compiles, invisible until someone diffs startup times. This module
+is the one copy, and :func:`active_cache_dir` is how the compile ledger
+(``observability/compile_ledger.py``) detects persistent-cache hits: an XLA
+compile that adds no entry to a live cache dir was served *from* it.
+
+Deliberately light: ``jax`` is imported inside the functions, so
+import-light CLIs can import this module without touching a backend.
+"""
+
+import os
+from typing import Optional
+
+#: The production default (the historical ``train_maml_system.py`` value):
+#: shared across entry points so a bench re-run reuses the training run's
+#: compiles and vice versa.
+DEFAULT_CACHE_DIR = os.path.join(os.path.expanduser("~"), ".cache", "htymp_tpu_xla")
+
+
+def resolve_cache_dir(cache_dir: str = "") -> str:
+    """Resolution order: explicit argument (``Config.compilation_cache_dir``)
+    > ``JAX_COMPILATION_CACHE_DIR`` env var (the standard JAX knob) >
+    :data:`DEFAULT_CACHE_DIR`."""
+    return cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR") or DEFAULT_CACHE_DIR
+
+
+def setup_compilation_cache(cache_dir: str = "", test_tuning: bool = False) -> str:
+    """Point JAX's persistent executable cache at the resolved directory and
+    return it. ``test_tuning=True`` additionally drops the min-entry-size /
+    min-compile-time thresholds (the conftest values) so the tiny programs
+    test suites and chaos drills compile still get cached.
+
+    Must run before the first compile (not before the first jax import);
+    safe to call more than once."""
+    import jax
+
+    resolved = resolve_cache_dir(cache_dir)
+    jax.config.update("jax_compilation_cache_dir", resolved)
+    if test_tuning:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    return resolved
+
+
+def active_cache_dir() -> Optional[str]:
+    """The cache dir jax is *actually* configured with right now (None when
+    the persistent cache is off). Never raises — callers use this for
+    best-effort hit accounting, not control flow."""
+    try:
+        import jax
+
+        value = jax.config.jax_compilation_cache_dir
+    except Exception:
+        value = None
+    return value or os.environ.get("JAX_COMPILATION_CACHE_DIR") or None
+
+
+def cache_entry_count(cache_dir: Optional[str] = None) -> Optional[int]:
+    """Number of entries in the persistent cache dir, or None when there is
+    no (existing) cache dir. The before/after delta across one XLA compile
+    is the hit/miss signal: a compile that wrote nothing new was a hit (or
+    fell below the size/time thresholds — the ledger records the raw delta
+    alongside the verdict so that ambiguity stays visible)."""
+    d = cache_dir or active_cache_dir()
+    if not d:
+        return None
+    try:
+        return len(os.listdir(d))
+    except OSError:
+        return None

@@ -27,6 +27,7 @@ static-arg cache misses the signature can't see are still caught.
 """
 
 import threading
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 
@@ -89,6 +90,11 @@ class RecompileGuard:
             else len(self.planned)  # type: ignore[arg-type]
         )
         self._lock = threading.Lock()
+        # optional CompileLedger (observability/compile_ledger.py): wrap()
+        # feeds it the first-call wall time of every new signature — the
+        # guard is a seam that already sees every compile, so attaching a
+        # ledger here prices guard-wrapped programs without a second hook
+        self.ledger = None
         self._seen: List[Any] = []
         # violating key -> message: a rejected key is NOT recorded as seen,
         # so a retried unplanned request re-raises instead of slipping past
@@ -187,14 +193,29 @@ class RecompileGuard:
                 cache_size = None
         state = {"last_cache": baseline, "baseline": baseline}
 
+        fn_label = getattr(fn, "__name__", None) or type(fn).__name__
+
         def wrapped(*args, **kwargs):
             sig = (
                 key_fn(*args, **kwargs)
                 if key_fn is not None
                 else abstract_signature((args, kwargs))
             )
+            before = self.lowerings
             self.note(sig)
+            ledger = self.ledger
+            # first call of a new signature = the call that pays the
+            # compile; the guard has no lowered object to split into
+            # lower/compile phases, so the ledger gets the total only
+            time_it = ledger is not None and self.lowerings > before
+            t0 = time.perf_counter() if time_it else 0.0
             out = fn(*args, **kwargs)
+            if time_it:
+                ledger.record(
+                    f"{self.name}/{fn_label}",
+                    total_s=time.perf_counter() - t0,
+                    signature_index=self.lowerings,
+                )
             if callable(cache_size):
                 try:
                     now = cache_size()

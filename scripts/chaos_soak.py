@@ -46,13 +46,15 @@ import jax  # noqa: E402
 if os.environ.get("CHAOS_ON_DEVICE") != "1":
     # a site hook may have imported jax earlier with another platform
     jax.config.update("jax_platforms", "cpu")
-jax.config.update(
-    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
-)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 from howtotrainyourmamlpytorch_tpu.resilience.campaign import run_campaign  # noqa: E402
+from howtotrainyourmamlpytorch_tpu.utils.compcache import (  # noqa: E402
+    setup_compilation_cache,
+)
+
+# shared persistent-cache setup (test tuning: the drill's tiny programs
+# must cache too); the env default above keeps subprocess episodes aligned
+setup_compilation_cache(test_tuning=True)
 
 
 def main(argv=None) -> int:

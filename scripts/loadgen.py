@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Open-loop SLO load test against a live ServingFrontend (ROADMAP item 1).
+
+Generates a seeded heavy-tailed request schedule over an offered-load
+staircase (``observability/slo.py``), drives an in-process
+``ServingFrontend`` with it — mixed adapt/predict, bucket-skewed query
+sizes, launched at schedule time whether or not earlier requests returned —
+and prints exactly ONE JSON SLO-report line on stdout (the ``bench.py`` /
+``bench_serving.py`` contract): per-stair p50/p99 vs offered load, shed
+rate, 503/504 counts, breaker trips, headline = highest offered load whose
+stair met the SLO. Progress goes to stderr.
+
+Runnable anywhere::
+
+    JAX_PLATFORMS=cpu python scripts/loadgen.py --seed 0 --duration-s 10
+    python scripts/loadgen.py --run-dir exps/<run> --stairs 20,40,80
+
+With no ``--run-dir`` a synthetic-weight engine is built in-process
+(``--tiny`` 2-stage backbone by default off-chip; ``--full`` for the real
+Conv-4). Same ``--seed`` => bit-identical schedule (``--print-schedule``
+emits it without touching a backend, for determinism checks).
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _parse_stairs(text: str):
+    try:
+        stairs = [float(x) for x in text.split(",") if x.strip()]
+    except ValueError:
+        stairs = []
+    if not stairs:
+        raise SystemExit(f"loadgen: --stairs must be comma-separated req/s, got {text!r}")
+    return stairs
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--duration-s", type=float, default=10.0)
+    parser.add_argument(
+        "--stairs", default="4,8,16",
+        help="comma-separated offered loads (req/s), one staircase stage each",
+    )
+    parser.add_argument("--adapt-frac", type=float, default=0.25,
+                        help="fraction of requests that are (uncached) adapts")
+    parser.add_argument("--slo-p99-ms", type=float, default=2000.0)
+    parser.add_argument("--max-shed-rate", type=float, default=0.05)
+    parser.add_argument("--run-dir", default=None,
+                        help="serve this experiment's checkpoint instead of synthetic weights")
+    parser.add_argument("--n-way", type=int, default=5)
+    parser.add_argument("--k-shot", type=int, default=1)
+    parser.add_argument("--full", action="store_true",
+                        help="full Conv-4 backbone (default: tiny 2-stage CI shape)")
+    parser.add_argument("--max-workers", type=int, default=16)
+    parser.add_argument(
+        "--print-schedule", action="store_true",
+        help="emit the request schedule as one JSON line and exit "
+        "(no backend contact; the determinism-check surface)",
+    )
+    args = parser.parse_args(argv)
+    stairs = _parse_stairs(args.stairs)
+
+    from howtotrainyourmamlpytorch_tpu.observability import slo
+
+    # bucket-skewed query sizes: most traffic on the small bucket, a tail on
+    # the big ones (matched to the engine's query_buckets below)
+    query_sizes, query_weights = (5, 15, 40), (0.7, 0.2, 0.1)
+    schedule = slo.generate_schedule(
+        args.seed,
+        args.duration_s,
+        stairs,
+        adapt_frac=args.adapt_frac,
+        query_sizes=query_sizes,
+        query_weights=query_weights,
+    )
+    if not schedule:
+        # fail fast BEFORE the backend spins up: heavy-tailed gaps over a
+        # short window can legitimately produce zero arrivals
+        raise SystemExit(
+            f"loadgen: schedule is empty for seed={args.seed} "
+            f"duration={args.duration_s}s stairs={stairs} — lengthen "
+            "--duration-s or raise --stairs"
+        )
+    if args.print_schedule:
+        print(
+            json.dumps(
+                {
+                    "schedule": [dataclasses.asdict(r) for r in schedule],
+                    "digest": slo.schedule_digest(schedule),
+                }
+            ),
+            flush=True,
+        )
+        return 0
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # a site hook may override platform selection after capturing the
+        # env; re-assert the user's choice (the bench_serving.py pattern)
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from howtotrainyourmamlpytorch_tpu.config import Config, ServingConfig
+    from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+    from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+    from howtotrainyourmamlpytorch_tpu.models import build_vgg
+    from howtotrainyourmamlpytorch_tpu.serving import AdaptationEngine
+    from howtotrainyourmamlpytorch_tpu.serving.server import ServingFrontend
+
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+
+    if args.run_dir:
+        from howtotrainyourmamlpytorch_tpu.serving.server import frontend_from_run_dir
+
+        frontend = frontend_from_run_dir(args.run_dir)
+        cfg = frontend.engine.cfg
+        n_way = cfg.num_classes_per_set
+        k_shot = cfg.num_samples_per_class
+        model_label = f"run:{os.path.basename(os.path.normpath(args.run_dir))}"
+    else:
+        n_way, k_shot = args.n_way, args.k_shot
+        img = (28, 28, 1)
+        cfg = Config(
+            num_classes_per_set=n_way,
+            num_samples_per_class=k_shot,
+            num_target_samples=max(max(query_sizes) // n_way, 1),
+            serving=ServingConfig(
+                support_buckets=[n_way * k_shot],
+                query_buckets=sorted(query_sizes),
+            ),
+        )
+        stages, filters = (4, 64) if args.full else (2, 4)
+        system = MAMLSystem(
+            cfg,
+            model=build_vgg(img, n_way, num_stages=stages, cnn_num_filters=filters),
+        )
+        frontend = ServingFrontend(
+            AdaptationEngine(system, system.init_train_state())
+        )
+        model_label = f"vgg{stages}x{filters}"
+    img_shape = cfg.image_shape if args.run_dir else (28, 28, 1)
+
+    max_query = max(max(query_sizes), max(r.n_query for r in schedule))
+    targets_per_class = max(max_query // n_way + 1, 1)
+
+    def episode(seed: int):
+        b = synthetic_batch(1, n_way, k_shot, targets_per_class, img_shape, seed & 0x7FFFFFFF)
+        return b
+
+    def make_support(seed: int):
+        b = episode(seed)
+        return b["x_support"][0], b["y_support"][0]
+
+    def make_query(seed: int, n_query: int):
+        b = episode(seed)
+        return b["x_target"][0].reshape((-1,) + tuple(img_shape))[:n_query]
+
+    log(
+        f"loadgen: seed={args.seed} duration={args.duration_s}s "
+        f"stairs={stairs} req/s, {len(schedule)} requests, model {model_label}"
+    )
+    run = slo.run_load(
+        frontend,
+        schedule,
+        make_support,
+        make_query,
+        max_workers=args.max_workers,
+        log=log,
+    )
+    report = slo.slo_report(
+        schedule,
+        run,
+        stairs_rps=stairs,
+        duration_s=args.duration_s,
+        seed=args.seed,
+        slo_p99_ms=args.slo_p99_ms,
+        max_shed_rate=args.max_shed_rate,
+        metric_suffix=f"_{n_way}w{k_shot}s",
+        platform=jax.default_backend(),
+        model=model_label,
+        adapt_frac=args.adapt_frac,
+        schedule_digest=slo.schedule_digest(schedule),
+    )
+    frontend.close()
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    t0 = time.monotonic()
+    rc = main()
+    print(f"loadgen: done in {time.monotonic() - t0:.1f}s", file=sys.stderr)
+    sys.exit(rc)

@@ -114,6 +114,76 @@ def _profile_dir_from_config(run_dir: str) -> Optional[str]:
     return None
 
 
+def _aggregate_compile_ledger(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The compile-tax section: per-program lower/compile seconds, build
+    counts, persistent-cache hits, and priced FLOPs, aggregated from
+    ``logs/compile_ledger.jsonl``. Deliberately re-implements
+    ``CompileLedger.summary()``'s aggregation: this script is import-light
+    (no package import, no jax — it must run against a run dir from a
+    wedged box), so it cannot replay entries through the ledger class.
+    Keep the two shapes in sync."""
+    by: Dict[str, Dict[str, Any]] = {}
+    for e in entries:
+        agg = by.setdefault(
+            str(e.get("program", "?")),
+            {
+                "builds": 0,
+                "lower_s": 0.0,
+                "compile_s": 0.0,
+                "total_s": 0.0,
+                "cache_hits": 0,
+                "errors": 0,
+                "flops": None,
+            },
+        )
+        agg["builds"] += 1
+        agg["lower_s"] = round(agg["lower_s"] + (e.get("lower_s") or 0.0), 3)
+        agg["compile_s"] = round(agg["compile_s"] + (e.get("compile_s") or 0.0), 3)
+        agg["total_s"] = round(agg["total_s"] + (e.get("total_s") or 0.0), 3)
+        if (e.get("persistent_cache") or {}).get("hit"):
+            agg["cache_hits"] += 1
+        if e.get("error"):
+            agg["errors"] += 1
+        if e.get("flops"):
+            agg["flops"] = e["flops"]
+    return {
+        "entries": len(entries),
+        "programs": len(by),
+        "total_lower_s": round(sum(p["lower_s"] for p in by.values()), 3),
+        "total_compile_s": round(sum(p["compile_s"] for p in by.values()), 3),
+        "total_s": round(sum(p["total_s"] for p in by.values()), 3),
+        "cache_hits": sum(p["cache_hits"] for p in by.values()),
+        "errors": sum(p["errors"] for p in by.values()),
+        "by_program": by,
+    }
+
+
+def _hbm_from_session(session: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Peak-HBM watermark over one process session, from the memory
+    provider rows the telemetry snapshots carry. None when the run had no
+    available memory stats (CPU backends)."""
+    peaks: List[float] = []
+    headrooms: List[float] = []
+    sampled = 0
+    for record in session:
+        mem = (record.get("providers") or {}).get("memory") or {}
+        if not mem.get("available_devices"):
+            continue
+        sampled += 1
+        if mem.get("peak_bytes_in_use_max") is not None:
+            peaks.append(float(mem["peak_bytes_in_use_max"]))
+        if mem.get("headroom_frac_min") is not None:
+            headrooms.append(float(mem["headroom_frac_min"]))
+    if not sampled:
+        return None
+    return {
+        "snapshots_with_stats": sampled,
+        "peak_bytes_in_use_max": max(peaks) if peaks else None,
+        "peak_gib": round(max(peaks) / 2**30, 3) if peaks else None,
+        "headroom_frac_min": min(headrooms) if headrooms else None,
+    }
+
+
 def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, Any]:
     logs_dir = os.path.join(run_dir, "logs")
     tel_path = os.path.join(logs_dir, "telemetry.jsonl")
@@ -178,8 +248,27 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
             "phases": phases,
             "providers": last.get("providers", {}),
             "dropped_spans": last.get("dropped_spans", 0),
+            "mfu": last.get("mfu"),
         }
     )
+
+    # peak HBM per session (observability/memory.py provider rows)
+    hbm = _hbm_from_session(session)
+    if hbm is not None:
+        report["hbm"] = hbm
+
+    # compile tax (logs/compile_ledger.jsonl), scoped to the reported
+    # session when the entries carry session ids
+    ledger_path = os.path.join(logs_dir, "compile_ledger.jsonl")
+    if os.path.exists(ledger_path):
+        entries, torn_ledger = _read_jsonl(ledger_path)
+        if torn_ledger:
+            report["torn_ledger_lines"] = torn_ledger
+        session_id = last.get("session")
+        scoped = [e for e in entries if e.get("session") == session_id]
+        report["compile_tax"] = _aggregate_compile_ledger(scoped or entries)
+        if not scoped and entries:
+            report["compile_tax"]["all_sessions"] = True
 
     # host-phase coverage vs the SAME session's epoch wall-clock (the
     # honesty check)
@@ -228,12 +317,17 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
 def oneline(report: Dict[str, Any]) -> str:
     """One compact JSON line per run for sweep logs."""
     phases = report.get("phases", {})
+    compile_tax = report.get("compile_tax") or {}
+    hbm = report.get("hbm") or {}
     slim = {
         "report": "obs",
         "run": report.get("run"),
         "error": report.get("error"),
         "epochs": report.get("epochs"),
         "episodes_per_s": report.get("episodes_per_s"),
+        "mfu": report.get("mfu"),
+        "compile_tax_s": compile_tax.get("total_s"),
+        "peak_hbm_gib": hbm.get("peak_gib"),
         "phase_coverage": report.get("phase_coverage"),
         "phase_p50_ms": {k: v.get("p50_ms") for k, v in phases.items()},
         "notable_events": report.get("notable_events"),
@@ -364,6 +458,37 @@ def render_human(report: Dict[str, Any]) -> str:
                 "  NOTE: coverage outside [0.9, 1.1] — phase spans do not "
                 "account for the train loop; trust the trace, not this table"
             )
+    if report.get("mfu") is not None:
+        lines.append(f"live MFU (last snapshot): {report['mfu']}")
+    tax = report.get("compile_tax")
+    if tax:
+        lines.append(
+            f"-- compile tax ({tax['entries']} compiles, "
+            f"{tax['total_s']}s total: {tax['total_lower_s']}s lower + "
+            f"{tax['total_compile_s']}s compile; "
+            f"{tax['cache_hits']} persistent-cache hits"
+            + (", ALL sessions" if tax.get("all_sessions") else "")
+            + ") --"
+        )
+        lines.append(
+            f"{'program':<28} {'builds':>6} {'lower s':>8} {'compile s':>9} "
+            f"{'hits':>5}  flops"
+        )
+        for name in sorted(tax["by_program"]):
+            p = tax["by_program"][name]
+            flops = f"{p['flops']:.3e}" if p.get("flops") else "-"
+            lines.append(
+                f"{name[:28]:<28} {p['builds']:>6} {p['lower_s']:>8} "
+                f"{p['compile_s']:>9} {p['cache_hits']:>5}  {flops}"
+            )
+    hbm = report.get("hbm")
+    if hbm:
+        lines.append(
+            f"-- HBM watermark (session) -- peak "
+            f"{hbm.get('peak_gib', '-')} GiB, min headroom "
+            f"{hbm.get('headroom_frac_min', '-')} "
+            f"({hbm['snapshots_with_stats']} sampled snapshots)"
+        )
     if report.get("events"):
         lines.append("-- events.jsonl --")
         lines.append(

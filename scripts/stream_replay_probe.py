@@ -32,11 +32,9 @@ if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 # persistent compile cache: the probe arms re-trace the same program family
 # (per emulation arm), and CPU compiles of the 20-way program cost 10-20 min
-if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.expanduser("~"), ".cache", "htymp_tpu_xla"),
-    )
+from howtotrainyourmamlpytorch_tpu.utils.compcache import setup_compilation_cache
+
+setup_compilation_cache()
 
 import dataclasses
 

@@ -626,6 +626,31 @@ def test_self_gate_covers_fleet_paths_explicitly():
     )
 
 
+def test_self_gate_covers_perf_obs_paths_explicitly():
+    """The performance-observability layer (ISSUE 7) sits inside the
+    self-gate on its own terms: the loadgen drives a threaded frontend
+    (GL201/GL202 territory), the compile ledger wraps jitted hot-path
+    programs (GL110 territory), and the compcache helper is imported by
+    every entry point — zero unsuppressed findings in all of it even if the
+    top-level path list is ever restructured."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join("howtotrainyourmamlpytorch_tpu", "observability"),
+                os.path.join("howtotrainyourmamlpytorch_tpu", "utils", "compcache.py"),
+                os.path.join("scripts", "loadgen.py"),
+                os.path.join("scripts", "obs_report.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in perf-obs paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_catches_an_introduced_true_positive(tmp_path):
     """End-to-end: drop one fixture true positive next to real package code
     and the CLI must exit 1 with a GL id on stdout."""

@@ -1,0 +1,727 @@
+"""Performance-observability layer (ISSUE 7): cost-model fallback chain,
+compile ledger cold/warm semantics, HBM watermark schema, loadgen schedule
+determinism + SLO report schema, and the off-switch zero-file contract
+extended to the new providers."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from PIL import Image
+
+from howtotrainyourmamlpytorch_tpu.config import (
+    Config,
+    DatasetConfig,
+    ObservabilityConfig,
+    ParallelConfig,
+    ServingConfig,
+)
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+from howtotrainyourmamlpytorch_tpu.observability import costs
+from howtotrainyourmamlpytorch_tpu.observability.compile_ledger import (
+    CompileLedger,
+)
+from howtotrainyourmamlpytorch_tpu.observability.memory import MemoryWatermarks
+from howtotrainyourmamlpytorch_tpu.observability import slo
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------------------
+# cost model (observability/costs.py)
+# ---------------------------------------------------------------------------
+
+
+def test_jit_cost_toy_program_nonnull_flops_on_cpu():
+    """The acceptance path bench.py rides: the HLO cost model prices a jit
+    on the CPU backend — non-null flops, no exception."""
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((32, 32), jnp.float32)
+    cost = costs.jit_cost(f, x)
+    assert cost["error"] is None
+    assert cost["flops"] and cost["flops"] > 0
+    assert cost["source"] in ("lowered", "compiled", "compiled_from_lowered")
+
+
+def test_program_cost_degrades_to_null_with_reason_never_raises():
+    """The BENCH_r02 crash class: cost_analysis that raises from inside jax
+    (even on attribute access), returns None, or returns junk must yield
+    flops=None with the reasons joined — never an exception."""
+
+    class RaisingProperty:
+        @property
+        def cost_analysis(self):
+            # the observed in-the-wild crash escapes as a non-Attribute
+            # error from inside jax's own property machinery
+            raise RuntimeError("'NoneType' object has no attribute 'get'")
+
+        def compile(self):
+            raise RuntimeError("backend gone")
+
+    cost = costs.program_cost(RaisingProperty())
+    assert cost["flops"] is None
+    assert "NoneType" in cost["error"] and "backend gone" in cost["error"]
+
+    class ReturnsNone:
+        def cost_analysis(self):
+            return None
+
+        def compile(self):
+            return self
+
+    assert costs.program_cost(ReturnsNone())["flops"] is None
+
+    cost = costs.program_cost(None)
+    assert cost["flops"] is None and "no lowered" in cost["error"]
+
+    # no .lower() on the callable: jit_cost degrades the same way
+    assert costs.jit_cost(lambda x: x, 1)["flops"] is None
+
+
+def test_program_cost_normalizes_plugin_return_shapes():
+    """List-wrapped per-device dicts and the 'bytes accessed' (with space)
+    key both normalize; a compiled-only object works without .compile()."""
+
+    class ListCompiled:  # no .compile attr => treated as compiled
+        def cost_analysis(self):
+            return [{"flops": 5.0, "bytes accessed": 7.0}]
+
+    cost = costs.program_cost(ListCompiled())
+    assert cost["flops"] == 5.0 and cost["bytes_accessed"] == 7.0
+    assert cost["source"] == "compiled"
+
+
+def test_mfu_table_lookup_and_reasons():
+    value, reason = costs.mfu(1e12, 2.0, "TPU v5e")
+    assert reason is None
+    assert value == pytest.approx(2e12 / 197e12, abs=5e-6)  # rounded to 5 dp
+    # explicit measured peak wins over the table
+    value, _ = costs.mfu(1e12, 2.0, "TPU v5e", peak=4e12)
+    assert value == pytest.approx(0.5)
+    value, reason = costs.mfu(1e12, 2.0, "cpu")
+    assert value is None and "no peak-FLOPs table entry" in reason
+    value, reason = costs.mfu(None, 2.0, "TPU v4")
+    assert value is None and "flops_per_step" in reason
+    value, reason = costs.mfu(1e12, 0.0, "TPU v4")
+    assert value is None and "steps_per_sec" in reason
+
+
+# ---------------------------------------------------------------------------
+# compile ledger (observability/compile_ledger.py)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_ledger_cold_warm_and_new_shape(tmp_path):
+    ledger = CompileLedger(logs_dir=str(tmp_path))
+    calls = {"n": 0}
+
+    def f(x):
+        calls["n"] += 1
+        return x * 2 + 1
+
+    wrapped = ledger.wrap_build(("toy", 1), jax.jit(f))
+    a = jnp.ones((4,), jnp.float32)
+    out1 = wrapped(a)
+    out2 = wrapped(a + 1)  # same signature: no new entry, compiled reused
+    np.testing.assert_allclose(np.asarray(out2), np.full((4,), 5.0))
+    assert ledger.summary()["entries"] == 1
+    wrapped(jnp.ones((8,), jnp.float32))  # new shape = a recompile = an entry
+    summary = ledger.summary()
+    assert summary["entries"] == 2
+    assert summary["by_program"]["toy/1"]["builds"] == 2
+    ledger.close()
+
+    entries = [
+        json.loads(line)
+        for line in open(os.path.join(tmp_path, "compile_ledger.jsonl"))
+    ]
+    assert len(entries) == 2
+    for e in entries:
+        assert e["program"] == "toy/1"
+        assert e["lower_s"] >= 0 and e["compile_s"] >= 0
+        assert e["total_s"] == pytest.approx(e["lower_s"] + e["compile_s"], abs=1e-3)
+        assert isinstance(e["cold"], bool)
+        assert "persistent_cache" in e and "flops" in e
+        # the conftest cache dir is live, so hit accounting must be present
+        assert e["persistent_cache"] is None or "hit" in e["persistent_cache"]
+    # the AOT split priced the program off the lowered/compiled pair
+    assert any(e["flops"] for e in entries)
+    assert np.asarray(out1).tolist() == [3.0] * 4
+
+
+def test_compile_ledger_broken_jit_falls_back_and_records_error(tmp_path):
+    ledger = CompileLedger(logs_dir=str(tmp_path))
+
+    class NoLower:
+        def __call__(self, x):
+            return x + 1
+
+    wrapped = ledger.wrap_build("broken", NoLower())
+    assert wrapped(1) == 2
+    # same signature: pinned to the plain callable, no second error entry
+    assert wrapped(1) == 2
+    summary = ledger.summary()
+    assert summary["errors"] == 1
+    assert summary["by_program"]["broken"]["errors"] == 1
+    ledger.close()
+
+
+def test_compile_ledger_observer_and_recompile_guard_seam():
+    from howtotrainyourmamlpytorch_tpu.utils.strictmode import RecompileGuard
+
+    ledger = CompileLedger()  # collector-only (the serving-frontend shape)
+    seen = []
+    ledger.on_entry = seen.append
+    guard = RecompileGuard(budget=4, name="probe")
+    guard.ledger = ledger
+    wrapped = guard.wrap(jax.jit(lambda x: x * x))
+    wrapped(jnp.ones((3,)))
+    wrapped(jnp.ones((3,)))  # warm: no new signature, no entry
+    wrapped(jnp.ones((5,)))
+    summary = ledger.summary()
+    assert summary["entries"] == 2
+    prog = summary["by_program"]["probe/<lambda>"]
+    assert prog["builds"] == 2 and prog["total_s"] > 0
+    assert len(seen) == 2 and all(e["total_s"] > 0 for e in seen)
+    # a broken observer must never break recording
+    ledger.on_entry = lambda e: 1 / 0
+    wrapped(jnp.ones((7,)))
+    assert ledger.summary()["entries"] == 3
+
+
+# ---------------------------------------------------------------------------
+# HBM watermarks (observability/memory.py)
+# ---------------------------------------------------------------------------
+
+
+def test_memory_snapshot_schema_on_this_backend():
+    """Whatever this backend supports, every row is explicit about it:
+    available rows carry the watermark fields, unavailable rows a reason."""
+    snap = MemoryWatermarks().snapshot()
+    assert set(snap) == {
+        "devices",
+        "available_devices",
+        "peak_bytes_in_use_max",
+        "headroom_frac_min",
+    }
+    assert len(snap["devices"]) >= 1
+    for row in snap["devices"]:
+        assert "device" in row and "kind" in row
+        if row["available"]:
+            assert {"bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                    "headroom_frac"} <= set(row)
+        else:
+            assert row["reason"]
+
+
+def test_memory_headroom_warning_latches_per_device():
+    rows = [
+        {"device": 0, "kind": "fake", "available": True, "bytes_in_use": 98,
+         "peak_bytes_in_use": 99, "bytes_limit": 100, "headroom_frac": 0.02},
+        {"device": 1, "kind": "fake", "available": True, "bytes_in_use": 10,
+         "peak_bytes_in_use": 20, "bytes_limit": 100, "headroom_frac": 0.9},
+    ]
+    mw = MemoryWatermarks(warn_headroom_frac=0.05, stats_fn=lambda: rows)
+
+    class Log:
+        def __init__(self):
+            self.records = []
+
+        def append(self, r):
+            self.records.append(r)
+
+    log = Log()
+    fired = mw.maybe_warn(log)
+    assert len(fired) == 1 and fired[0]["device"] == 0
+    assert fired[0]["event"] == "hbm_headroom_low"
+    assert log.records == fired
+    # latched: the same device hovering below threshold fires once
+    assert mw.maybe_warn(log) == []
+    snap = mw.snapshot()
+    assert snap["peak_bytes_in_use_max"] == 99
+    assert snap["headroom_frac_min"] == 0.02
+
+
+# ---------------------------------------------------------------------------
+# loadgen schedule + SLO report (observability/slo.py, scripts/loadgen.py)
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_same_seed_bit_identical_different_seed_not():
+    kw = dict(duration_s=30.0, stairs_rps=[2.0, 4.0, 8.0], adapt_frac=0.3)
+    a = slo.generate_schedule(7, **kw)
+    b = slo.generate_schedule(7, **kw)
+    assert a == b  # frozen dataclasses: full-field bit-identity
+    assert slo.schedule_digest(a) == slo.schedule_digest(b)
+    c = slo.generate_schedule(8, **kw)
+    assert a != c
+    # stairs partition the duration; times are monotonic within the run
+    times = [r.t for r in a]
+    assert times == sorted(times)
+    per_stair = 30.0 / 3
+    for r in a:
+        assert r.stair * per_stair <= r.t < (r.stair + 1) * per_stair
+        assert r.kind in ("adapt", "predict")
+        assert r.n_query in (5, 15, 40)
+
+
+def test_loadgen_cli_print_schedule_bit_identical():
+    cmd = [
+        sys.executable, os.path.join(REPO_ROOT, "scripts", "loadgen.py"),
+        "--seed", "0", "--duration-s", "5", "--print-schedule",
+    ]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    runs = [
+        subprocess.run(cmd, capture_output=True, text=True, timeout=120, env=env)
+        for _ in range(2)
+    ]
+    for proc in runs:
+        assert proc.returncode == 0, proc.stderr
+        assert len(proc.stdout.strip().splitlines()) == 1  # exactly one line
+    assert runs[0].stdout == runs[1].stdout
+    payload = json.loads(runs[0].stdout)
+    assert payload["digest"]["n"] == len(payload["schedule"])
+
+
+def test_slo_report_schema_and_sustained_headline():
+    stairs = [2.0, 4.0]
+    schedule = slo.generate_schedule(3, 10.0, stairs)
+    rows = []
+    for r in schedule:
+        # stair 0 healthy, stair 1 drowning: half shed, slow p99
+        if r.stair == 0:
+            rows.append({"stair": 0, "kind": r.kind, "outcome": "ok",
+                         "latency_ms": 10.0})
+        else:
+            outcome = "shed" if len(rows) % 2 else "ok"
+            rows.append({"stair": 1, "kind": r.kind, "outcome": outcome,
+                         "latency_ms": 900.0})
+    run = {"rows": rows, "wall_s": 10.0,
+           "breaker_trips": 1, "breaker": {"opens": 1}}
+    report = slo.slo_report(
+        schedule, run, stairs_rps=stairs, duration_s=10.0, seed=3,
+        slo_p99_ms=500.0, max_shed_rate=0.05, metric_suffix="_5w1s",
+    )
+    assert report["metric"] == "serving_slo_sustained_rps_5w1s"
+    assert report["unit"] == "req/s within SLO"
+    assert report["vs_baseline"] is None
+    assert report["value"] == 2.0  # only the healthy stair met the SLO
+    assert report["breaker_trips"] == 1
+    assert len(report["stairs"]) == 2
+    s0, s1 = report["stairs"]
+    assert s0["slo_met"] and not s1["slo_met"]
+    assert s0["p99_ms"] == 10.0 and s0["shed_rate"] == 0.0
+    assert s1["shed"] > 0 and s1["shed_rate"] > 0.05
+    assert report["requests"] == len(schedule)
+    assert report["ok"] + report["shed"] + report["deadline"] + report["error"] == len(schedule)
+    json.dumps(report)  # one-line contract: everything serializes
+
+
+def test_run_load_against_tiny_frontend():
+    """The in-process e2e: a real ServingFrontend under a short open-loop
+    schedule — outcomes for every scheduled request, warmup excluded,
+    breaker delta reported."""
+    from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+    from howtotrainyourmamlpytorch_tpu.serving import AdaptationEngine
+    from howtotrainyourmamlpytorch_tpu.serving.server import ServingFrontend
+
+    img = (28, 28, 1)
+    cfg = Config(
+        num_classes_per_set=5,
+        num_samples_per_class=1,
+        num_target_samples=2,
+        serving=ServingConfig(support_buckets=[5], query_buckets=[5, 10]),
+    )
+    system = MAMLSystem(
+        cfg, model=build_vgg(img, 5, num_stages=2, cnn_num_filters=4)
+    )
+    frontend = ServingFrontend(AdaptationEngine(system, system.init_train_state()))
+
+    def make_support(seed):
+        b = synthetic_batch(1, 5, 1, 2, img, seed & 0x7FFFFFFF)
+        return b["x_support"][0], b["y_support"][0]
+
+    def make_query(seed, n_query):
+        b = synthetic_batch(1, 5, 1, 2, img, seed & 0x7FFFFFFF)
+        return b["x_target"][0].reshape((-1,) + img)[:n_query]
+
+    schedule = slo.generate_schedule(
+        0, 1.5, [4.0], adapt_frac=0.3, query_sizes=(5, 10), query_weights=(0.8, 0.2)
+    )
+    try:
+        run = slo.run_load(frontend, schedule, make_support, make_query)
+    finally:
+        frontend.close()
+    assert len(run["rows"]) == len(schedule)
+    assert all(r["outcome"] in ("ok", "shed", "deadline", "error") for r in run["rows"])
+    assert sum(1 for r in run["rows"] if r["outcome"] == "ok") >= 1
+    assert run["breaker_trips"] == 0
+    report = slo.slo_report(
+        schedule, run, stairs_rps=[4.0], duration_s=1.5, seed=0,
+        slo_p99_ms=30_000.0, max_shed_rate=1.0,
+    )
+    assert report["requests"] == len(schedule)
+    # the serving programs' compiles landed in the frontend's ledger, and
+    # warmup compiled EVERY query bucket the schedule hits (a cold bucket
+    # compile inside a measured stair would poison that stair's p99)
+    compiled = frontend.engine.compile_counts()
+    assert compiled["compile_ledger"]["entries"] >= 2
+    warmed = {
+        name.split("/")[1]
+        for name in compiled["compile_ledger"]["by_program"]
+        if name.startswith("serve_predict/")
+    }
+    for n_query in {r.n_query for r in schedule}:
+        bucket = min(b for b in (5, 10) if b >= n_query)
+        assert str(bucket) in warmed, (n_query, warmed)
+
+
+def test_run_load_empty_schedule_raises():
+    with pytest.raises(ValueError, match="schedule is empty"):
+        slo.run_load(None, [], lambda s: None, lambda s, n: None)
+
+
+def test_run_load_latency_counts_queue_wait_from_scheduled_arrival():
+    """The coordinated-omission guard: with one worker and a slow backend,
+    the second request's latency must include the time it spent queued
+    behind the first — measured from its scheduled arrival, not worker
+    pickup."""
+    import time
+
+    class SlowFrontend:
+        class _Breaker:
+            def snapshot(self):
+                return {"opens": 0}
+
+        breaker = _Breaker()
+
+        def adapt(self, x, y):
+            return {"adaptation_id": "warm"}
+
+        def predict(self, aid, xq):
+            time.sleep(0.25)
+            return None
+
+    schedule = [
+        slo.Request(t=0.0, kind="predict", episode_seed=0, n_query=5, stair=0),
+        slo.Request(t=0.01, kind="predict", episode_seed=1, n_query=5, stair=0),
+    ]
+    run = slo.run_load(
+        SlowFrontend(), schedule, lambda s: (None, None), lambda s, n: None,
+        warm_adaptations=1, max_workers=1,
+    )
+    lat = sorted(r["latency_ms"] for r in run["rows"])
+    assert lat[0] >= 200  # the slow predict itself
+    assert lat[1] >= 400  # ~250ms queued behind request 1 + its own 250ms
+
+
+def test_live_mfu_zero_step_interval_reports_zero_not_lifetime(tmp_path):
+    """A snapshot over an interval with zero settled steps must say mfu=0.0
+    (no training ran), never fall back to the healthy lifetime average."""
+    from howtotrainyourmamlpytorch_tpu.observability.telemetry import TelemetryHub
+
+    t = {"now": 0.0}
+    hub = TelemetryHub(
+        enabled=True, logs_dir=str(tmp_path), clock=lambda: t["now"],
+        export_chrome_trace=False,
+    )
+    hub.registry.set_gauge("flops_per_step", 1e9)
+    hub.registry.set_gauge("peak_flops_per_sec", 1e12)
+    for _ in range(10):
+        hub.step_completed(episodes=1)
+    t["now"] = 1.0
+    busy = hub.snapshot("step")
+    assert busy["mfu"] == pytest.approx(10 * 1e9 / 1e12)
+    t["now"] = 2.0  # a whole interval of eval/checkpoint: zero steps
+    idle = hub.snapshot("epoch")
+    assert idle["interval_steps_per_s"] == 0.0
+    assert idle["mfu"] == 0.0
+    hub.close()
+
+
+def test_live_mfu_counts_meta_steps_under_multi_dispatch(tmp_path):
+    """With train_steps_per_dispatch=K the runner settles ONE dispatch per
+    K meta-steps; interval_steps_per_s (and the MFU it feeds, against the
+    per-meta-step flops gauge) must count meta-steps, not dispatches —
+    the review-found factor-of-K MFU understatement."""
+    from howtotrainyourmamlpytorch_tpu.observability.telemetry import TelemetryHub
+
+    t = {"now": 0.0}
+    hub = TelemetryHub(
+        enabled=True, logs_dir=str(tmp_path), clock=lambda: t["now"],
+        export_chrome_trace=False,
+    )
+    hub.registry.set_gauge("flops_per_step", 1e9)  # per META-step (÷K)
+    hub.registry.set_gauge("peak_flops_per_sec", 1e12)
+    for _ in range(5):  # 5 dispatches x K=4 = 20 meta-steps over 1s
+        hub.step_completed(episodes=8, steps=4)
+    t["now"] = 1.0
+    rec = hub.snapshot("epoch")
+    assert rec["steps"] == 20
+    assert rec["interval_steps_per_s"] == pytest.approx(20.0)
+    assert rec["mfu"] == pytest.approx(20 * 1e9 / 1e12)
+    hub.close()
+    # the K-jump cadence: a K-step jump over a multiple of
+    # snapshot_every_steps still fires the step snapshot (crossing check,
+    # not modulo — K=2 never lands exactly on a multiple of 3)
+    cadence = TelemetryHub(
+        enabled=True, snapshot_every_steps=3, export_chrome_trace=False,
+    )
+    fired = []
+    cadence.snapshot = lambda kind, **kw: fired.append(kind)  # count only
+    for _ in range(5):  # _steps: 2, 4, 6, 8, 10 — crossings at 4 and 8
+        cadence.step_completed(episodes=1, steps=2)
+    assert fired == ["step", "step"]
+
+
+def test_run_load_unresolved_request_costs_grace_not_report():
+    """A request the backend never answers (hung flush, deadlocked
+    frontend — what a load test exists to surface) must cost at most
+    result_grace_s and an `unresolved` count, never the report itself."""
+    import threading
+
+    hang = threading.Event()
+
+    class WedgedFrontend:
+        class _Breaker:
+            def snapshot(self):
+                return {"opens": 0}
+
+        breaker = _Breaker()
+        predicts = 0
+
+        def adapt(self, x, y):
+            return {"adaptation_id": "warm"}
+
+        def predict(self, aid, xq):
+            self.predicts += 1
+            if self.predicts == 1:
+                return None  # the warmup predict passes; measured traffic wedges
+            hang.wait(timeout=30.0)
+            return None
+
+    schedule = [
+        slo.Request(t=0.0, kind="predict", episode_seed=0, n_query=5, stair=0),
+    ]
+    try:
+        run = slo.run_load(
+            WedgedFrontend(), schedule, lambda s: (None, None),
+            lambda s, n: None, warm_adaptations=1, max_workers=1,
+            result_grace_s=0.5,
+        )
+    finally:
+        hang.set()  # release the worker thread either way
+    assert run["unresolved"] == 1 and run["unresolved_by_stair"] == {0: 1}
+    report = slo.slo_report(
+        schedule, run, stairs_rps=[1.0], duration_s=1.0, seed=0,
+        slo_p99_ms=1000.0, max_shed_rate=0.5,
+    )
+    assert report["unresolved"] == 1 and report["requests"] == 1
+    assert report["stairs"][0]["unresolved"] == 1
+    assert not report["stairs"][0]["slo_met"] and report["value"] is None
+
+
+def test_warmup_compiles_batch_bucket_grid():
+    """The MicroBatcher flushes task-batches under concurrency, so warmup
+    must compile the (bucket x batch-bucket) grid up front — a cold
+    serve_predict/(bucket, b>1) compile inside a measured stair would
+    poison that stair's p99."""
+    assert slo._batch_buckets(8) == [1, 2, 4, 8]
+    assert slo._batch_buckets(6) == [1, 2, 4, 6]
+    assert slo._batch_buckets(1) == [1]
+
+    class _Engine:
+        class serving:
+            max_batch_size = 4
+
+        def __init__(self):
+            self.calls = []
+
+        def adapt(self, x, y):
+            self.calls.append(("adapt", 1))
+            return {"w": None}
+
+        def adapt_batch(self, items):
+            self.calls.append(("adapt", len(items)))
+            return [{"w": None}] * len(items)
+
+        def predict_batch(self, items):
+            self.calls.append(("predict", len(items)))
+            return [None] * len(items)
+
+    class _Frontend:
+        engine = None
+
+    frontend = _Frontend()
+    frontend.engine = _Engine()
+    schedule = [
+        slo.Request(t=0.0, kind="predict", episode_seed=0, n_query=5, stair=0),
+        slo.Request(t=0.1, kind="predict", episode_seed=1, n_query=15, stair=0),
+    ]
+    slo._warm_batch_buckets(
+        frontend, schedule, lambda s: (None, None), lambda s, n: n, lambda m: None
+    )
+    calls = frontend.engine.calls
+    # every >1 batch bucket per kind; both scheduled query sizes for predict
+    assert ("adapt", 2) in calls and ("adapt", 4) in calls
+    assert calls.count(("predict", 2)) == 2 and calls.count(("predict", 4)) == 2
+    # a frontend without an engine (test double) degrades to a logged skip
+    logged = []
+    slo._warm_batch_buckets(
+        object(), schedule, lambda s: (None, None), lambda s, n: n, logged.append
+    )
+    assert any("skipped" in m for m in logged)
+
+
+# ---------------------------------------------------------------------------
+# runner e2e: ledger file, MFU gauges, obs_report sections, off-switch
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def toy_dataset(tmp_path_factory):
+    root = tmp_path_factory.mktemp("data") / "omniglot_toy"
+    rng = np.random.RandomState(0)
+    for a in range(4):
+        for c in range(5):
+            d = root / f"alpha{a}" / f"char{c}"
+            d.mkdir(parents=True)
+            base = (rng.rand(28, 28) > 0.5).astype(np.uint8) * 255
+            for i in range(6):
+                noisy = base ^ (rng.rand(28, 28) > 0.95).astype(np.uint8) * 255
+                Image.fromarray(noisy, mode="L").convert("1").save(d / f"{i}.png")
+    return str(root)
+
+
+def _toy_config(toy_dataset, tmp_path, name, **overrides):
+    base = dict(
+        dataset=DatasetConfig(name="omniglot_toy", path=toy_dataset),
+        num_classes_per_set=3,
+        num_samples_per_class=2,
+        num_target_samples=2,
+        batch_size=2,
+        parallel=ParallelConfig(dp=2),
+        total_epochs=1,
+        total_iter_per_epoch=3,
+        num_evaluation_tasks=4,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        experiment_root=str(tmp_path),
+        experiment_name=name,
+        load_into_memory=True,
+        num_dataprovider_workers=2,
+        train_val_test_split=(0.6, 0.2, 0.2),
+        conv_via_patches=True,  # the dp-sharded native-conv GSPMD crash dodge
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def _toy_system(cfg):
+    return MAMLSystem(
+        cfg,
+        model=build_vgg(
+            (28, 28, 1), cfg.num_classes_per_set, num_stages=2,
+            cnn_num_filters=4, conv_via_patches=True,
+        ),
+    )
+
+
+def test_runner_compile_ledger_and_mfu_fields_e2e(toy_dataset, tmp_path):
+    cfg = _toy_config(toy_dataset, tmp_path, "perf_obs_on")
+    runner = ExperimentRunner(cfg, system=_toy_system(cfg))
+    runner.run_experiment()
+    logs = os.path.join(runner.run_dir, "logs")
+
+    # compile_ledger.jsonl: the train + eval programs, priced and timed
+    entries = [
+        json.loads(line)
+        for line in open(os.path.join(logs, "compile_ledger.jsonl"))
+    ]
+    programs = {e["program"] for e in entries}
+    assert any(p.startswith("train/") for p in programs), programs
+    assert "eval" in programs
+    for e in entries:
+        assert e["total_s"] is not None and e["total_s"] >= 0
+        assert "session" in e
+    train_entry = next(e for e in entries if e["program"].startswith("train/"))
+    assert train_entry["flops"] and train_entry["flops"] > 0
+
+    # telemetry: the cost gauges + the live-mfu contract (null on CPU with
+    # the reason gauge set, never a crash)
+    records = [
+        json.loads(line) for line in open(os.path.join(logs, "telemetry.jsonl"))
+    ]
+    last = records[-1]
+    assert last["gauges"]["flops_per_step"] == train_entry["flops"]
+    assert "mfu_unavailable_reason" in last["gauges"]
+    assert "mfu" in last and last["mfu"] is None
+    assert any(r.get("interval_steps_per_s") is not None for r in records)
+    assert "memory" in last["providers"] and "compile_ledger" in last["providers"]
+    assert last["providers"]["compile_ledger"]["entries"] == len(entries)
+
+    # obs_report: compile-tax section + the new oneline fields
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "obs_report.py"),
+         runner.run_dir, "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    tax = report["compile_tax"]
+    assert tax["entries"] == len(entries)
+    assert tax["total_s"] == pytest.approx(
+        sum(e["total_s"] for e in entries), abs=0.05
+    )
+    assert set(tax["by_program"]) == programs
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "obs_report.py"),
+         runner.run_dir, "--oneline"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    line = json.loads(proc.stdout)
+    assert line["compile_tax_s"] == tax["total_s"]
+    # mfu is null on CPU => dropped from the oneline rather than lying
+    assert "mfu" not in line
+
+    # human render carries the compile-tax table
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "obs_report.py"),
+         runner.run_dir],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "compile tax" in proc.stdout
+
+
+def test_off_switch_zero_file_extends_to_perf_providers(toy_dataset, tmp_path):
+    """PR 5's inertness contract extended: with observability disabled the
+    new providers leave no compile_ledger.jsonl (and no telemetry/trace),
+    and the system's program builds stay plain jit objects."""
+    cfg = _toy_config(
+        toy_dataset, tmp_path, "perf_obs_off",
+        observability=ObservabilityConfig(enabled=False),
+    )
+    system = _toy_system(cfg)
+    runner = ExperimentRunner(cfg, system=system)
+    assert runner._compile_ledger is None and runner._memory is None
+    assert system.compile_ledger is None
+    result = runner.run_experiment()
+    assert "test_accuracy_mean" in result
+    logs = os.path.join(runner.run_dir, "logs")
+    for name in ("compile_ledger.jsonl", "telemetry.jsonl", "trace.json"):
+        assert not os.path.exists(os.path.join(logs, name)), name
+    # the program cache holds plain jitted callables, not ledger wrappers
+    fn = system._compiled_train_step(True, True)
+    assert type(fn).__name__ != "LedgerWrapped"

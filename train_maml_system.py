@@ -39,18 +39,15 @@ def main(argv=None):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     # Persistent XLA compilation cache: re-runs of the same (shape, config)
-    # programs skip the 20-40s first compile (overridable via the standard
-    # JAX_COMPILATION_CACHE_DIR env var).
-    if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.expanduser("~"), ".cache", "htymp_tpu_xla"),
-        )
-
+    # programs skip the 20-40s first compile. One shared helper
+    # (utils/compcache.py) owns the setup; Config.compilation_cache_dir >
+    # JAX_COMPILATION_CACHE_DIR env > the shared default.
     from howtotrainyourmamlpytorch_tpu.config import load_config
     from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
+    from howtotrainyourmamlpytorch_tpu.utils.compcache import setup_compilation_cache
 
     cfg = load_config(args.config, args.overrides)
+    setup_compilation_cache(cfg.compilation_cache_dir)
     runner = ExperimentRunner(cfg)
     print(f"run dir: {runner.run_dir}")
     print(f"n_params: {runner.system.num_params(runner.state)}")
