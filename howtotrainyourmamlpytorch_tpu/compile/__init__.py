@@ -1,0 +1,16 @@
+"""Ahead-of-time compilation subsystem (ROADMAP item 2: kill the compile
+tax). ``compile.aot`` prewarms the strict-mode planned program sets before
+the first step / first request and persists the evidence two ways: the JAX
+persistent compilation cache (the XLA artifact) and an executable-store
+manifest next to the checkpoints (the warm-start contract a fresh process
+verifies before accepting work)."""
+
+from .aot import (  # noqa: F401
+    ExecutableStore,
+    build_manifest,
+    ensure_persistent_cache,
+    environment_fingerprint,
+    prewarm_serving,
+    prewarm_train,
+    verify_manifest,
+)

@@ -1,0 +1,618 @@
+"""AOT prewarm: compile the planned program set ahead of the first step.
+
+BENCH_r02 measured 37.9 s of compile+warmup before the first useful train
+step, and every serving replica re-pays that tax per shape bucket on first
+touch. The pieces to kill it already exist and are composed here:
+
+- strict mode *enumerates* the full planned program set
+  (``utils/strictmode.py``: ``train_planned_programs`` — the
+  ``(single|multi, second_order, msl)`` train variants plus eval — and
+  ``serving_planned_programs`` — the (kind, shape-bucket, batch-bucket)
+  grid);
+- the compile ledger (``observability/compile_ledger.py``) already does the
+  explicit ``.lower()``/``.compile()`` AOT split with per-signature caching
+  and persistent-cache hit accounting (``utils/compcache.py``).
+
+:func:`prewarm_train` / :func:`prewarm_serving` walk the planned set,
+build each program through the system's/engine's own program-cache seam (so
+the strict :class:`RecompileGuard` notes every key — the prewarm plan and
+the guard's planned set cannot drift apart), and warm each one through
+``LedgerWrapped.warm`` — lower timed, compile timed, one ledger entry with
+``phase="prewarm"``, **no execution**. Arguments are
+``jax.ShapeDtypeStruct`` specs (shape/dtype/sharding only — the same
+abstract signature a real call computes), so prewarm never materializes a
+batch. Compiles overlap across programs in a bounded thread pool — XLA
+compiles release the GIL, so the overlap is real even on one core.
+
+Warm artifacts persist two ways:
+
+1. the JAX persistent compilation cache (:func:`ensure_persistent_cache`
+   wires ``utils/compcache.py`` on when nothing else has): the XLA
+   artifact itself, so a restarted run pays tracing, not XLA;
+2. an **executable store** written alongside the checkpoints
+   (:class:`ExecutableStore`): the fully serialized executables
+   (``jax.experimental.serialize_executable``), one file per (program,
+   signature), so a restarted run or a freshly spawned fleet/serving
+   replica skips tracing AND XLA entirely — on the toy CPU benchmark this
+   is the difference between a ~50% and a ~90% compile-tax kill, because
+   tracing is what the compilation cache cannot absorb. Its **manifest**
+   (``experiment/checkpoint.py::save_prewarm_manifest``): program key ->
+   signature digest, a jax/jaxlib/backend/device-kind/mesh fingerprint,
+   and the cache dir's entry digest — is how a fresh process *verifies* it
+   will hit warm (:func:`verify_manifest`) before accepting work; a
+   jaxlib/device-kind change gates the store to write-only and falls back
+   to a logged cold compile instead of loading stale artifacts.
+
+After a prewarm the strict guard is sealed (``mark_prewarmed``): any
+program compiled outside prewarm is a finding, not a convenience — the
+contract flips from "detect drift" to "enforce the prewarmed set".
+
+Entry points: the runner (``experiment/runner.py``) under ``Config.aot``,
+the serving frontend (``serving/server.py`` — background, with ``/healthz``
+503 "warming" until done), ``scripts/prewarm.py`` standalone, and
+``scripts/loadgen.py``'s pre-clock warmup (via ``AdaptationEngine.prewarm``).
+"""
+
+import hashlib
+import os
+import pickle
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.compcache import (
+    active_cache_dir,
+    cache_entry_count,
+    setup_compilation_cache,
+)
+
+MANIFEST_VERSION = 1
+
+#: fingerprint fields that must match exactly for a manifest to promise a
+#: warm start — a different jaxlib serializes different executables, a
+#: different device kind compiles different code, a different mesh bakes
+#: different shardings into every program
+_FINGERPRINT_FIELDS = ("jax", "jaxlib", "backend", "device_kind", "n_devices", "mesh")
+
+
+# ---------------------------------------------------------------------------
+# argument specs (ShapeDtypeStruct pytrees — nothing is materialized)
+# ---------------------------------------------------------------------------
+
+
+def _sds(shape: Tuple[int, ...], dtype, sharding=None):
+    import jax
+
+    try:
+        return jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=sharding)
+    except TypeError:  # a jax without the sharding kwarg: shape/dtype only
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def shape_specs(tree, leading: Tuple[int, ...] = ()):
+    """Pytree of arrays -> matching pytree of ``ShapeDtypeStruct`` specs
+    (per-leaf shardings carried when the leaves have them), optionally with
+    extra ``leading`` axes — the non-materializing argument form
+    ``LedgerWrapped.warm`` lowers against. Works on device arrays and host
+    numpy alike."""
+    import jax
+
+    def spec(leaf):
+        return _sds(
+            tuple(leading) + tuple(np.shape(leaf)),
+            leaf.dtype,
+            # only device arrays carry a sharding; adding leading axes to a
+            # sharded leaf would misalign its spec, so shardings only ride
+            # the no-leading (real argument) form
+            getattr(leaf, "sharding", None) if not leading else None,
+        )
+
+    return jax.tree.map(spec, tree)
+
+
+def train_batch_spec(cfg, sharding=None, leading: Tuple[int, ...] = ()):
+    """The loader's episode-batch pytree as specs: leaves shaped
+    ``leading + [B, n_way, k, ...]`` with the loader's dtypes (x float32,
+    y int32), ``B = batch_size * samples_per_iter`` — exactly what
+    ``MetaLearningDataLoader`` yields and ``runner._put`` places."""
+    b = cfg.batch_size * cfg.samples_per_iter
+    n_way, k = cfg.num_classes_per_set, cfg.num_samples_per_class
+    t = cfg.num_target_samples
+    h, w, c = cfg.image_shape
+    lead = tuple(leading)
+    return {
+        "x_support": _sds(lead + (b, n_way, k, h, w, c), np.float32, sharding),
+        "y_support": _sds(lead + (b, n_way, k), np.int32, sharding),
+        "x_target": _sds(lead + (b, n_way, t, h, w, c), np.float32, sharding),
+        "y_target": _sds(lead + (b, n_way, t), np.int32, sharding),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the warm pool
+# ---------------------------------------------------------------------------
+
+
+def _warm_one(fn: Callable, args: Sequence[Any], store=None) -> Dict[str, Any]:
+    warm = getattr(fn, "warm", None)
+    if warm is not None:
+        return warm(*args, store=store)
+    # a plain jitted program (built before any ledger was attached): AOT
+    # lower+compile still seeds the persistent cache, but jit's own call
+    # cache stays cold — the first real call re-traces and hits the cache
+    fn.lower(*args).compile()
+    return {"already_warm": False, "signature": None, "unledgered": True}
+
+
+def signature_digest(sig: Any) -> Optional[str]:
+    """Short stable digest of a warm()'d abstract signature — the manifest's
+    program identity and the executable store's file key (the full repr is
+    pages of pytree; the digest is structural, so the spec-built prewarm
+    signature and a real call's signature digest identically across
+    processes)."""
+    if sig is None:
+        return None
+    return hashlib.sha256(repr(sig).encode()).hexdigest()[:16]
+
+
+class ExecutableStore:
+    """Serialized-executable persistence: one pickle of
+    ``jax.experimental.serialize_executable.serialize(compiled)`` (payload +
+    in/out pytree defs) per (program, signature digest), written atomically
+    under ``<saved_models>/executables/``. Loading one skips tracing AND
+    XLA — the part of the cold start the persistent compilation cache
+    cannot absorb. ``allow_load=False`` makes the store write-only: the
+    caller verified the manifest fingerprint and refuses to load artifacts
+    serialized by a different jaxlib/device-kind/mesh (deserialization of a
+    stale payload is undefined behavior, not a recoverable miss). Load and
+    save failures are counted, never raised — a broken store degrades to a
+    plain cold compile."""
+
+    def __init__(self, directory: str, allow_load: bool = True):
+        self.dir = directory
+        self.allow_load = allow_load
+        self._lock = threading.Lock()
+        self._counts = {"loads": 0, "saves": 0, "load_errors": 0, "save_errors": 0}
+
+    def _bump(self, key: str) -> None:
+        with self._lock:
+            self._counts[key] += 1
+
+    def _path(self, program: str, digest: str) -> str:
+        return os.path.join(self.dir, f"{program.replace('/', '_')}__{digest}.exe")
+
+    def load(self, program: str, sig: Any) -> Optional[Callable]:
+        """The warm fast path: deserialize the stored executable for this
+        (program, signature), or None (absent / gated / unreadable)."""
+        digest = signature_digest(sig)
+        if not self.allow_load or digest is None:
+            return None
+        path = self._path(program, digest)
+        if not os.path.exists(path):
+            return None
+        try:
+            from jax.experimental import serialize_executable
+
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+            fn = serialize_executable.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception:  # noqa: BLE001 — torn file, version skew, pickle drift
+            self._bump("load_errors")
+            return None
+        self._bump("loads")
+        return fn
+
+    def save(self, program: str, sig: Any, compiled: Callable) -> bool:
+        """Serialize a freshly compiled executable (atomic tmp+rename — a
+        kill mid-write must never leave a torn store entry). Non-``Compiled``
+        objects (an AOT-failure fallback to plain jit) are skipped."""
+        import jax
+
+        digest = signature_digest(sig)
+        if digest is None or not isinstance(compiled, jax.stages.Compiled):
+            return False
+        try:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+            os.makedirs(self.dir, exist_ok=True)
+            path = self._path(program, digest)
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as f:
+                pickle.dump((payload, in_tree, out_tree), f)
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 — full disk, unpicklable callback, ...
+            self._bump("save_errors")
+            return False
+        self._bump("saves")
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            counts = dict(self._counts)
+        return {"dir": self.dir, "allow_load": self.allow_load, **counts}
+
+
+def _run_warm_pool(
+    jobs: List[Tuple[str, Callable, Sequence[Any]]],
+    ledger,
+    guard,
+    max_workers: int,
+    compile_timeout_s: float,
+    on_program: Optional[Callable[[str], None]],
+    store: Optional[ExecutableStore] = None,
+) -> Dict[str, Any]:
+    """Warm every job and fold the results + the ledger delta into one
+    summary. With ``max_workers > 1``: a bounded pool of DAEMON worker
+    threads overlaps compiles; a program exceeding the compile budget is
+    reported, not waited on forever — and because the workers are daemons,
+    a wedged XLA compile can't block process exit either (a
+    ThreadPoolExecutor's non-daemon workers would be joined at interpreter
+    shutdown, turning the contained timeout back into a hang). With ONE
+    worker the jobs run inline on the calling thread instead: a lone worker
+    buys no overlap, and its loads/compiles convoy with the caller's
+    ``Event.wait`` on the GIL (measured 2-4x inflation on a 1-core box) —
+    inline mode has exact timings and leaves hang coverage to the caller's
+    watchdog (the runner beats per program). Warm failures are contained
+    per program either way (that program stays lazily jitted)."""
+    before = ledger.summary() if ledger is not None else None
+    t0 = time.perf_counter()
+    results: Dict[str, Dict[str, Any]] = {}
+    errors: Dict[str, str] = {}
+    workers = max(1, min(max_workers, len(jobs) or 1))
+    if workers == 1:
+        for name, fn, args in jobs:
+            try:
+                results[name] = _warm_one(fn, args, store)
+            except Exception as exc:  # noqa: BLE001 — contained per program
+                errors[name] = f"{type(exc).__name__}: {exc}"
+            if on_program is not None:
+                on_program(name)
+    else:
+        outcome_lock = threading.Lock()
+        outcomes: Dict[str, Any] = {}
+        done = {name: threading.Event() for name, _, _ in jobs}
+        job_queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        for job in jobs:
+            job_queue.put(job)
+
+        def worker() -> None:
+            while True:
+                try:
+                    name, fn, args = job_queue.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    out = _warm_one(fn, args, store)
+                except Exception as exc:  # noqa: BLE001 — contained per program
+                    out = exc
+                with outcome_lock:
+                    outcomes[name] = out
+                done[name].set()
+
+        for i in range(workers):
+            threading.Thread(
+                target=worker, name=f"prewarm-{i}", daemon=True
+            ).start()
+        for name, _, _ in jobs:
+            # per-program budget, measured from when its wait starts (the
+            # same semantics fut.result(timeout=...) gave): queued jobs
+            # keep compiling while earlier ones are waited on
+            if done[name].wait(timeout=compile_timeout_s):
+                with outcome_lock:
+                    out = outcomes[name]
+                if isinstance(out, Exception):
+                    errors[name] = f"{type(out).__name__}: {out}"
+                else:
+                    results[name] = out
+            else:
+                errors[name] = (
+                    f"TimeoutError: still compiling past the "
+                    f"{compile_timeout_s}s prewarm budget"
+                )
+            if on_program is not None:
+                on_program(name)
+    wall = time.perf_counter() - t0
+    after = ledger.summary() if ledger is not None else None
+    by_program: Dict[str, Dict[str, Any]] = {}
+    for name, _, _ in jobs:
+        res = results.get(name)
+        agg = (after or {}).get("by_program", {}).get(name, {})
+        by_program[name] = {
+            "signature": signature_digest((res or {}).get("signature")),
+            "total_s": agg.get("total_s"),
+            "cache_hit": bool(agg.get("cache_hits")),
+            "loaded": bool((res or {}).get("loaded")),
+            "stored": bool((res or {}).get("stored")),
+            "already_warm": bool((res or {}).get("already_warm")),
+        }
+        if name in errors:
+            by_program[name]["error"] = errors[name]
+    summary = {
+        "programs": len(jobs),
+        "seconds": round(wall, 3),
+        "compile_s": (
+            round(after["total_s"] - before["total_s"], 3)
+            if before is not None
+            else None
+        ),
+        "cache_hits": (
+            after["cache_hits"] - before["cache_hits"] if before is not None else 0
+        ),
+        # programs that skipped tracing AND XLA via the executable store —
+        # the deepest warm-start tier
+        "store_hits": sum(1 for r in results.values() if r.get("loaded")),
+        "already_warm": sum(1 for r in results.values() if r.get("already_warm")),
+        "errors": len(errors),
+        "by_program": by_program,
+    }
+    if store is not None:
+        summary["store"] = store.stats()
+    if guard is not None:
+        # the contract flip: the planned family is now declared complete —
+        # any later first-compile is a strict-mode finding
+        guard.mark_prewarmed()
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# train-side prewarm (MAMLSystem)
+# ---------------------------------------------------------------------------
+
+
+def prewarm_train(
+    system,
+    state,
+    batch_sharding=None,
+    chunk_sharding=None,
+    max_workers: int = 4,
+    compile_timeout_s: float = 3600.0,
+    on_program: Optional[Callable[[str], None]] = None,
+    store: Optional[ExecutableStore] = None,
+) -> Dict[str, Any]:
+    """AOT-compile the ENTIRE planned train family — exactly
+    ``train_planned_programs(cfg)``, the same registry the strict
+    ``RecompileGuard`` enforces, so plan and guard cannot drift: every
+    ``(train|train_multi, second_order, msl)`` variant plus ``eval`` and
+    ``eval_multi``. ``state`` supplies the TrainState specs (as placed —
+    shardings ride along); batch specs come from the config's episode
+    shape. Attaches a collector-only compile ledger when the system has
+    none (the warm executables live in ``LedgerWrapped``'s per-signature
+    cache, which is also how the first real dispatch finds them)."""
+    from ..observability.compile_ledger import CompileLedger, program_name
+    from ..utils.strictmode import train_planned_programs
+
+    cfg = system.cfg
+    if system.compile_ledger is None:
+        system.attach_compile_ledger(CompileLedger())
+    plan = train_planned_programs(cfg)
+    state_spec = shape_specs(state)
+    batch = train_batch_spec(cfg, batch_sharding)
+    k = max(1, cfg.train_steps_per_dispatch)
+    chunk = train_batch_spec(cfg, chunk_sharding, leading=(k,))
+    n_eval = max(cfg.num_evaluation_tasks // (cfg.batch_size * cfg.samples_per_iter), 1)
+    eval_stack = train_batch_spec(cfg, chunk_sharding, leading=(n_eval,))
+    jobs: List[Tuple[str, Callable, Sequence[Any]]] = []
+    # deterministic job order (plan is a set — sorted, or the pool order
+    # and the manifest would wander run to run)
+    for key in sorted(plan, key=repr):
+        kind = key[0]
+        if kind == "train":
+            fn, args = system._compiled_train_step(key[1], key[2]), (state_spec, batch)
+        elif kind == "train_multi":
+            fn, args = system._compiled_train_multi(key[1], key[2]), (state_spec, chunk)
+        elif kind == "eval":
+            fn, args = system._eval_step, (state_spec, batch)
+        elif kind == "eval_multi":
+            fn, args = system._compiled_eval_multi(), (state_spec, eval_stack)
+        else:  # a future planned kind: skip loudly in the summary
+            continue
+        jobs.append((program_name(key), fn, args))
+    return _run_warm_pool(
+        jobs,
+        system.compile_ledger,
+        system.recompile_guard,
+        max_workers,
+        compile_timeout_s,
+        on_program,
+        store=store,
+    )
+
+
+# ---------------------------------------------------------------------------
+# serving-side prewarm (AdaptationEngine)
+# ---------------------------------------------------------------------------
+
+
+def prewarm_serving(
+    engine,
+    max_workers: int = 4,
+    compile_timeout_s: float = 3600.0,
+    image_shape: Optional[Tuple[int, int, int]] = None,
+    on_program: Optional[Callable[[str], None]] = None,
+    store: Optional[ExecutableStore] = None,
+) -> Dict[str, Any]:
+    """AOT-compile the full serving grid — exactly
+    ``serving_planned_programs(engine.serving)``: (adapt|predict) x shape
+    bucket x task-batch bucket, the same set the strict guard pins. This is
+    THE warm path a fresh replica runs before accepting work (and what
+    ``scripts/loadgen.py`` runs before its measurement clock starts —
+    previously a hand-rolled duplicate of this grid)."""
+    from ..observability.compile_ledger import CompileLedger
+    from ..utils.strictmode import serving_planned_programs
+
+    if engine.compile_ledger is None:
+        engine.compile_ledger = CompileLedger()
+    h, w, c = image_shape or engine.cfg.image_shape
+    params = engine.state.params
+    plan = serving_planned_programs(engine.serving)
+    fw_specs: Dict[int, Any] = {}
+    jobs: List[Tuple[str, Callable, Sequence[Any]]] = []
+    for key in sorted(plan, key=repr):
+        kind, bucket, b = key
+        if kind == "adapt":
+            fn = engine._compiled_adapt(bucket, b)
+            args = (
+                _sds((b, bucket, h, w, c), np.float32),
+                _sds((b, bucket), np.int32),
+                _sds((b, bucket), np.float32),
+            )
+            name = f"serve_adapt/{bucket}/{b}"
+        else:  # predict: per-item fast weights stacked on the task axis
+            fn = engine._compiled_predict(bucket, b)
+            if b not in fw_specs:
+                fw_specs[b] = shape_specs(params, leading=(b,))
+            args = (
+                fw_specs[b],
+                _sds((b, bucket, h, w, c), np.float32),
+                _sds((b, bucket), np.float32),
+            )
+            name = f"serve_predict/{bucket}/{b}"
+        jobs.append((name, fn, args))
+    return _run_warm_pool(
+        jobs,
+        engine.compile_ledger,
+        engine.recompile_guard,
+        max_workers,
+        compile_timeout_s,
+        on_program,
+        store=store,
+    )
+
+
+# ---------------------------------------------------------------------------
+# persistence: the cache wiring + the executable-store manifest
+# ---------------------------------------------------------------------------
+
+
+def ensure_persistent_cache(cfg=None) -> Optional[str]:
+    """Make sure the persistent XLA compilation cache is on (the default-on
+    wiring ``Config.aot`` promises): a no-op when an entry point already
+    configured it, otherwise ``utils/compcache.py``'s standard setup with
+    the config's directory. Returns the active dir."""
+    active = active_cache_dir()
+    if active:
+        return active
+    return setup_compilation_cache(getattr(cfg, "compilation_cache_dir", "") or "")
+
+
+def environment_fingerprint(mesh_shape=None) -> Dict[str, Any]:
+    """What the compiled executables are valid FOR: jax/jaxlib versions
+    (serialization format), backend + device kind (the code XLA emitted),
+    device count and mesh (the shardings baked into every program)."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", None)
+    except Exception:  # noqa: BLE001 — fingerprint must never block a run
+        jaxlib_version = None
+    try:
+        device_kind = str(jax.devices()[0].device_kind)
+        n_devices = len(jax.devices())
+    except Exception:  # noqa: BLE001
+        device_kind, n_devices = None, None
+    return {
+        "jax": getattr(jax, "__version__", None),
+        "jaxlib": jaxlib_version,
+        "backend": jax.default_backend(),
+        "device_kind": device_kind,
+        "n_devices": n_devices,
+        "mesh": list(mesh_shape) if mesh_shape is not None else None,
+    }
+
+
+def cache_state(cache_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Entry count + listing digest of the persistent cache dir — the
+    manifest's proof that the XLA artifacts it promises actually exist."""
+    d = cache_dir or active_cache_dir()
+    try:
+        names = sorted(os.listdir(d)) if d else []
+    except OSError:
+        names = []
+    return {
+        "dir": d,
+        "entries": len(names),
+        "digest": hashlib.sha256("\n".join(names).encode()).hexdigest()
+        if names
+        else None,
+    }
+
+
+def build_manifest(
+    train_summary: Optional[Dict[str, Any]] = None,
+    serving_summary: Optional[Dict[str, Any]] = None,
+    mesh_shape=None,
+    store: Optional[ExecutableStore] = None,
+) -> Dict[str, Any]:
+    """The executable-store manifest: program key -> signature digest +
+    compile seconds + cache/store verdicts, under the environment
+    fingerprint, the cache dir's state, and the store's counters. Written
+    alongside checkpoints
+    (``experiment/checkpoint.py::save_prewarm_manifest``)."""
+    programs: Dict[str, Any] = {}
+    for summary in (train_summary, serving_summary):
+        if summary:
+            programs.update(summary.get("by_program", {}))
+    manifest = {
+        "version": MANIFEST_VERSION,
+        "ts": time.time(),
+        "fingerprint": environment_fingerprint(mesh_shape),
+        "cache": cache_state(),
+        "programs": programs,
+    }
+    if store is not None:
+        manifest["store"] = store.stats()
+    return manifest
+
+
+#: the environment-only subset: what a SINGLE-DEVICE consumer (the serving
+#: grid — its programs never bake a mesh) must match. A replica spawned
+#: with fewer visible devices than the training host can still load the
+#: serving executables it stored, so its warm check skips n_devices/mesh.
+ENVIRONMENT_FIELDS = ("jax", "jaxlib", "backend", "device_kind")
+
+
+def verify_manifest(
+    manifest: Optional[Dict[str, Any]],
+    mesh_shape=None,
+    fields: Tuple[str, ...] = _FINGERPRINT_FIELDS,
+) -> Tuple[bool, Optional[str]]:
+    """Will a prewarm against THIS process hit warm? ``(True, None)`` when
+    the manifest's fingerprint matches the live environment and its cache
+    entries are still present; ``(False, reason)`` otherwise — the caller
+    proceeds with a cold compile and logs the reason instead of trusting
+    stale artifacts. ``fields`` narrows the fingerprint comparison (e.g.
+    :data:`ENVIRONMENT_FIELDS` for single-device serving programs, whose
+    validity doesn't depend on the training host's device count or mesh).
+    Never raises."""
+    if not manifest:
+        return False, "no prewarm manifest"
+    if manifest.get("version") != MANIFEST_VERSION:
+        return False, f"unknown manifest version {manifest.get('version')!r}"
+    then = manifest.get("fingerprint") or {}
+    now = environment_fingerprint(mesh_shape)
+    for name in fields:
+        if name == "mesh" and mesh_shape is None:
+            continue  # caller doesn't know its mesh yet: don't guess
+        if then.get(name) != now.get(name):
+            return False, (
+                f"fingerprint mismatch: {name} manifest={then.get(name)!r} "
+                f"!= current={now.get(name)!r}"
+            )
+    cache = manifest.get("cache") or {}
+    if not cache.get("entries"):
+        return False, "manifest records no persistent-cache entries"
+    entries_now = cache_entry_count(cache.get("dir"))
+    if entries_now is None:
+        return False, f"persistent cache dir {cache.get('dir')!r} is gone"
+    if entries_now < int(cache["entries"]):
+        return False, (
+            f"persistent cache at {cache.get('dir')} shrank "
+            f"({entries_now} < {cache['entries']} entries)"
+        )
+    return True, None

@@ -195,6 +195,43 @@ class ObservabilityConfig:
 
 
 @dataclass
+class AotConfig:
+    """AOT prewarm knobs (``compile/aot.py``; ROADMAP item 2 — kill the
+    compile tax). Enabled, the runner and serving frontend lower+compile the
+    *entire* strict-mode planned program set at startup — before the first
+    step / first request — through the compile ledger (every compile timed,
+    ``phase="prewarm"``), backed by the persistent XLA compilation cache
+    (``utils/compcache.py``) so a restarted run or a freshly spawned replica
+    pays tracing, not XLA. An executable-store manifest written alongside
+    checkpoints (program key -> signature, jaxlib/device-kind/mesh
+    fingerprint, cache digest) lets a fresh process verify it will hit warm
+    before accepting work. Disabled (the default): zero files, no prewarm,
+    programs stay the plain lazily-jitted objects they always were."""
+
+    enabled: bool = False
+    # bounded thread pool overlapping program compiles (XLA compiles release
+    # the GIL, so overlap is real even on one core)
+    max_workers: int = 4
+    # per-program compile budget inside the pool; generous — a cold 20-way
+    # second-order train program is minutes of XLA on a slow backend
+    compile_timeout_s: float = 3600.0
+    # write/read the prewarm manifest next to the checkpoints
+    executable_store: bool = True
+    # serving: prewarm on a background thread so the HTTP server binds
+    # immediately and /healthz says 503 "warming" until the set is compiled;
+    # False compiles synchronously before the frontend accepts work
+    serving_background: bool = True
+
+    def __post_init__(self):
+        if self.max_workers < 1:
+            raise ValueError(f"aot.max_workers must be >= 1, got {self.max_workers}")
+        if self.compile_timeout_s <= 0:
+            raise ValueError(
+                f"aot.compile_timeout_s must be > 0, got {self.compile_timeout_s}"
+            )
+
+
+@dataclass
 class WatchdogConfig:
     """Hang (wedge) supervisor knobs (``resilience/watchdog.py``). A device
     call that hangs instead of raising is invisible to every raise-based
@@ -479,6 +516,8 @@ class Config:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     # --- telemetry (observability/ package; no reference equivalent) ---
     observability: ObservabilityConfig = field(default_factory=ObservabilityConfig)
+    # --- AOT prewarm (compile/ package; ROADMAP item 2) ---
+    aot: AotConfig = field(default_factory=AotConfig)
     compute_dtype: str = "float32"  # or "bfloat16" for MXU-friendly compute
     remat_inner_steps: bool = True  # jax.checkpoint per inner step (SURVEY §5.7)
     # Fully unroll the inner-step lax.scan: removes scan sequencing overhead
@@ -670,8 +709,8 @@ def _dataclass_from_dict(cls, data: Dict[str, Any]):
         if name not in data:
             continue
         value = data[name]
-        if name in ("dataset", "inner_optim", "parallel", "serving", "resilience", "observability"):
-            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig, "serving": ServingConfig, "resilience": ResilienceConfig, "observability": ObservabilityConfig}[name]
+        if name in ("dataset", "inner_optim", "parallel", "serving", "resilience", "observability", "aot"):
+            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig, "serving": ServingConfig, "resilience": ResilienceConfig, "observability": ObservabilityConfig, "aot": AotConfig}[name]
             presets = {"dataset": DATASET_PRESETS, "inner_optim": INNER_OPTIM_PRESETS}.get(name, {})
             if isinstance(value, str):
                 if value not in presets:

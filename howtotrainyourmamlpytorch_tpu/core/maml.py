@@ -778,6 +778,14 @@ class MAMLSystem:
         _, ys = jax.lax.scan(body, (), batches)
         return ys
 
+    def _compiled_eval_multi(self):
+        if self._eval_multi is None:
+            self._note_program(("eval_multi",))
+            self._eval_multi = self._build_program(
+                ("eval_multi",), lambda: jax.jit(self._eval_multi_impl)
+            )
+        return self._eval_multi
+
     def eval_step_multi(self, state: TrainState, batches):
         """Every eval batch in ONE dispatch: ``lax.scan`` of the eval step
         over ``batches`` with a leading ``[N]`` axis. Same per-batch math as
@@ -785,9 +793,43 @@ class MAMLSystem:
         the whole fixed evaluation set (75 dispatches/epoch at the flagship
         config's 600 tasks / batch 8). Returns
         ``(per_task_losses [N, B], per_task_accuracies [N, B])``."""
-        if self._eval_multi is None:
-            self._note_program(("eval_multi",))
-            self._eval_multi = self._build_program(
-                ("eval_multi",), lambda: jax.jit(self._eval_multi_impl)
-            )
-        return self._eval_multi(state, batches)
+        return self._compiled_eval_multi()(state, batches)
+
+    # ------------------------------------------------------------------
+    # AOT prewarm (compile/aot.py; ROADMAP item 2)
+    # ------------------------------------------------------------------
+
+    def prewarm(
+        self,
+        state: TrainState,
+        batch_sharding=None,
+        chunk_sharding=None,
+        max_workers: Optional[int] = None,
+        compile_timeout_s: Optional[float] = None,
+        on_program=None,
+        store=None,
+    ) -> Dict[str, Any]:
+        """AOT-compile the ENTIRE planned train program family — the exact
+        ``train_planned_programs`` set the strict guard enforces — before
+        the first step, every compile timed through the ledger with
+        ``phase="prewarm"``, nothing executed. Shardings: pass the runner's
+        batch/chunk shardings so the warmed programs bake the placements
+        the real dispatches use. Returns the prewarm summary (programs,
+        seconds, persistent-cache hits, per-program table)."""
+        from ..compile.aot import prewarm_train
+
+        aot_cfg = getattr(self.cfg, "aot", None)
+        return prewarm_train(
+            self,
+            state,
+            batch_sharding=batch_sharding,
+            chunk_sharding=chunk_sharding,
+            max_workers=max_workers
+            if max_workers is not None
+            else getattr(aot_cfg, "max_workers", 4),
+            compile_timeout_s=compile_timeout_s
+            if compile_timeout_s is not None
+            else getattr(aot_cfg, "compile_timeout_s", 3600.0),
+            on_program=on_program,
+            store=store,
+        )

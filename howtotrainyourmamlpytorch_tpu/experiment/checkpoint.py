@@ -32,6 +32,7 @@ one-save lag, mirroring the runner's one-dispatch-lag pipeline.
 """
 
 import hashlib
+import json
 import os
 import re
 import threading
@@ -570,6 +571,40 @@ class AsyncCheckpointWriter:
 
     def close(self) -> None:
         self.wait()
+
+
+# ---------------------------------------------------------------------------
+# AOT executable-store manifest (compile/aot.py) — co-located with the
+# checkpoints so the warm-start contract travels with the run: a restarted
+# process (or a fleet scheduler about to spawn one) reads it HERE to verify
+# it will hit the persistent compilation cache warm before accepting work.
+# ---------------------------------------------------------------------------
+
+PREWARM_MANIFEST = "prewarm_manifest.json"
+
+
+def prewarm_manifest_path(save_dir: str) -> str:
+    return os.path.join(save_dir, PREWARM_MANIFEST)
+
+
+def save_prewarm_manifest(save_dir: str, manifest: Dict[str, Any]) -> str:
+    """Atomic write (same tmp+rename discipline as the checkpoints — a
+    kill mid-write must leave the previous manifest, never a torn one)."""
+    os.makedirs(save_dir, exist_ok=True)
+    path = prewarm_manifest_path(save_dir)
+    _write_atomic(path, json.dumps(manifest, indent=1).encode())
+    return path
+
+
+def load_prewarm_manifest(save_dir: str) -> Optional[Dict[str, Any]]:
+    """None when absent or unreadable — a bad manifest degrades the reader
+    to a cold start, exactly like no manifest at all."""
+    path = prewarm_manifest_path(save_dir)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
 
 
 def latest_checkpoint_exists(save_dir: str) -> bool:

@@ -84,6 +84,11 @@ class ExperimentRunner:
         device_probe=None,
     ):
         self.cfg = cfg
+        # cold-start clock: process-side anchor for the cold_start_s gauge
+        # (init -> first settled step), the number the AOT prewarm exists
+        # to shrink (ROADMAP item 2; BENCH_r02: 37.9 s)
+        self._t_init = time.perf_counter()
+        self._cold_start_s: Optional[float] = None
         # the cheap visible-device probe used at init (degraded-mesh plan)
         # and at epoch boundaries while degraded (grow-back plan);
         # injectable so elasticity drills can walk a device count up and
@@ -520,6 +525,7 @@ class ExperimentRunner:
             # isolated NaNs hours apart must never add up to a rollback
             self._bad_steps = 0
             self.hub.step_completed(episodes, steps=steps)
+            self._note_cold_start()
             return True
 
         preempted = False
@@ -557,6 +563,7 @@ class ExperimentRunner:
                     losses.append(chunk_losses)
                     accs.append(chunk_accs)
                     self.hub.step_completed(chunk_episodes, steps=K)
+                    self._note_cold_start()
                     continue
                 if pending is not None and not settle():
                     # settle() restored the pre-poison state, which also
@@ -605,6 +612,7 @@ class ExperimentRunner:
                     losses.append(out.loss)
                     accs.append(out.accuracy)
                     self.hub.step_completed(self.loader.batch_size)
+                    self._note_cold_start()
                     continue
                 if pending is not None and not settle():
                     self._note_bad_step(epoch)
@@ -650,6 +658,104 @@ class ExperimentRunner:
         """Progress mark feeding the wedge watchdog (no-op when disabled)."""
         if self._watchdog is not None:
             self._watchdog.beat(stage)
+
+    def _note_cold_start(self) -> None:
+        """First settled train step: the cold-start tax (runner init ->
+        first useful step) becomes a gauge + event, so the AOT prewarm's
+        effect is a tracked number, not a vibe."""
+        if self._cold_start_s is not None:
+            return
+        self._cold_start_s = round(time.perf_counter() - self._t_init, 3)
+        if self.hub.enabled:
+            self.hub.registry.set_gauge("cold_start_s", self._cold_start_s)
+        self.events.append(
+            {
+                "ts": time.time(),
+                "event": "cold_start",
+                "cold_start_s": self._cold_start_s,
+                "prewarmed": bool(self.cfg.aot.enabled),
+            }
+        )
+
+    # ------------------------------------------------------------------
+    # AOT prewarm (compile/aot.py; Config.aot)
+    # ------------------------------------------------------------------
+
+    def _prewarm_programs(self) -> None:
+        """Compile the ENTIRE planned train program family before the first
+        step (the same registry the strict guard enforces), every compile
+        timed through the ledger (``phase="prewarm"``), then persist the
+        warm-start contract: the persistent XLA cache holds the artifacts,
+        and the executable-store manifest next to the checkpoints records
+        what a restarted process can expect to hit warm. An existing
+        manifest is verified first — a jaxlib/device-kind/mesh change logs
+        the mismatch and proceeds cold rather than trusting stale
+        artifacts. Failures here are contained: prewarm is an optimization,
+        never a reason to kill a run."""
+        from ..compile import aot
+
+        cfg = self.cfg
+        cache_dir = aot.ensure_persistent_cache(cfg)
+        mesh_shape = self._mesh_shape()
+        expected_warm, reason = aot.verify_manifest(
+            ckpt.load_prewarm_manifest(self.saved_models_dir), mesh_shape
+        )
+        self.events.append(
+            {
+                "ts": time.time(),
+                "event": "prewarm_manifest",
+                "expected_warm": expected_warm,
+                "reason": reason,
+            }
+        )
+        if not expected_warm:
+            print(f"prewarm: no warm-start promise ({reason}); compiling", flush=True)
+        # the executable store: stored programs deserialize (no tracing, no
+        # XLA); loads are gated on the manifest verdict so a jaxlib/device/
+        # mesh change compiles cold instead of loading stale artifacts
+        store = None
+        if cfg.aot.executable_store:
+            store = aot.ExecutableStore(
+                os.path.join(self.saved_models_dir, "executables"),
+                allow_load=expected_warm,
+            )
+        try:
+            summary = self.system.prewarm(
+                self.state,
+                batch_sharding=getattr(self, "_batch_sharding", None),
+                chunk_sharding=getattr(self, "_chunk_sharding", None),
+                # each warmed program is watchdog progress: a long planned
+                # compile set must never read as a wedge
+                on_program=lambda name: self._beat(f"prewarm {name}"),
+                store=store,
+            )
+        except Exception as exc:  # noqa: BLE001 — prewarm must not kill the run
+            print(f"warning: prewarm failed (continuing cold): {exc!r}", flush=True)
+            self.events.append(
+                {"ts": time.time(), "event": "prewarm_failed", "error": repr(exc)}
+            )
+            return
+        slim = {k: v for k, v in summary.items() if k != "by_program"}
+        print(
+            f"prewarm: {summary['programs']} programs in {summary['seconds']}s "
+            f"({summary['store_hits']} executable-store hits, "
+            f"{summary['cache_hits']} persistent-cache hits, "
+            f"cache {cache_dir})",
+            flush=True,
+        )
+        self.events.append({"ts": time.time(), "event": "prewarm", **slim})
+        if self.hub.enabled:
+            self.hub.registry.set_gauge("prewarm", slim)
+        if cfg.aot.executable_store:
+            try:
+                ckpt.save_prewarm_manifest(
+                    self.saved_models_dir,
+                    aot.build_manifest(
+                        train_summary=summary, mesh_shape=mesh_shape, store=store
+                    ),
+                )
+            except OSError as exc:
+                print(f"warning: prewarm manifest not written: {exc!r}", flush=True)
 
     def _drain_ckpt_writer(self) -> None:
         """Block until any in-flight async save lands; a failed save is
@@ -1253,6 +1359,12 @@ class ExperimentRunner:
         if cfg.evaluate_on_test_set_only:
             self.load_best()
             return self.evaluate_test()
+
+        # AOT prewarm (Config.aot): the entire planned program set compiles
+        # HERE — inside the watchdog scope, before the first step — so the
+        # first epoch starts warm and a restarted run pays tracing, not XLA
+        if cfg.aot.enabled:
+            self._prewarm_programs()
 
         end_epoch = min(cfg.total_epochs, self.start_epoch + cfg.total_epochs_before_pause)
         for epoch in range(self.start_epoch, end_epoch):

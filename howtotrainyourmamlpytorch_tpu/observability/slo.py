@@ -139,40 +139,29 @@ class _Results:
             return list(self._rows)
 
 
-def _batch_buckets(max_batch: int) -> List[int]:
-    """The batch sizes ``engine._batch_bucket`` can round a flush up to:
-    powers of two capped at ``max_batch``, plus ``max_batch`` itself."""
-    out = []
-    b = 1
-    while b < max_batch:
-        out.append(b)
-        b *= 2
-    out.append(max_batch)
-    return sorted(set(out))
-
-
 def _warm_batch_buckets(frontend, schedule, make_support, make_query, log) -> None:
-    """Compile the (bucket x batch-bucket) grid batched flushes will hit:
-    under concurrency the frontend's MicroBatcher dispatches task-batches,
-    so the single-request warmup alone leaves every ``serve_*/(bucket,
-    b>1)`` program cold — and its first mid-stair compile would bill XLA
-    seconds to that stair's p99, the exact poisoning warmup exists to
-    prevent. Degrades to a logged skip on frontends without an engine
+    """Compile the full (bucket x batch-bucket) grid batched flushes will
+    hit by delegating to ``AdaptationEngine.prewarm()`` (``compile/aot.py``)
+    — the SAME planned-set compile a fresh serving replica runs, instead of
+    the hand-rolled grid loop this function used to duplicate. Under
+    concurrency the frontend's MicroBatcher dispatches task-batches, so the
+    single-request warmup alone leaves every ``serve_*/(bucket, b>1)``
+    program cold — and its first mid-stair compile would bill XLA seconds
+    to that stair's p99, the exact poisoning warmup exists to prevent.
+    Degrades to a logged skip on frontends without a prewarm-capable engine
     (test doubles) — the single-request warmup already ran."""
     engine = getattr(frontend, "engine", None)
-    if engine is None:
+    prewarm = getattr(engine, "prewarm", None)
+    if engine is None or prewarm is None:
         log("loadgen: batch-bucket warmup skipped (frontend has no engine)")
         return
     try:
-        buckets = [b for b in _batch_buckets(engine.serving.max_batch_size) if b > 1]
-        x_s, y_s = make_support(-1)
-        fw = engine.adapt(x_s, y_s)
-        for b in buckets:
-            engine.adapt_batch([(x_s, y_s)] * b)
-        for n_query in sorted({r.n_query for r in schedule}):
-            q = make_query(-1, n_query)
-            for b in buckets:
-                engine.predict_batch([(fw, q)] * b)
+        summary = prewarm()
+        log(
+            f"loadgen: prewarmed {summary['programs']} serving programs in "
+            f"{summary['seconds']}s ({summary['cache_hits']} persistent-cache "
+            f"hits, {summary['errors']} errors)"
+        )
     except Exception as exc:  # noqa: BLE001 — warmup must not kill the test
         log(
             "loadgen: batch-bucket warmup failed (continuing): "

@@ -289,6 +289,30 @@ class FleetScheduler:
             pass
         self._log(f"fleet: {event} " + " ".join(f"{k}={v}" for k, v in fields.items()))
 
+    def _warm_manifest(self, cell: FleetCell) -> Optional[Dict[str, Any]]:
+        """Warm-spawn readiness: a relaunched cell whose run dir carries an
+        AOT prewarm manifest (``compile/aot.py``, written next to the
+        checkpoints) is expected to hit the persistent compilation cache
+        and be stepping in seconds — the scheduler records the expectation
+        at launch so a restart that then burns minutes of XLA reads as the
+        anomaly it is. Pure JSON read (this module stays jax-free); the
+        child process does the authoritative fingerprint verification."""
+        path = os.path.join(
+            self.exps_root, cell.name, "saved_models", "prewarm_manifest.json"
+        )
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError):
+            return None
+        fingerprint = manifest.get("fingerprint") or {}
+        return {
+            "programs": len(manifest.get("programs") or {}),
+            "device_kind": fingerprint.get("device_kind"),
+            "jaxlib": fingerprint.get("jaxlib"),
+            "cache_entries": (manifest.get("cache") or {}).get("entries"),
+        }
+
     def _liveness_age_s(self, out_path: Optional[str]) -> float:
         if not out_path or not os.path.exists(out_path):
             return 0.0
@@ -389,10 +413,13 @@ class FleetScheduler:
                     pass
             cell.status = "running"
             running[id(cell)] = (cell, proc, out_path, self._clock())
-            self._event(
-                "cell_launch", cell=cell.name, attempt=cell.attempts,
-                restart=cell.restarts,
-            )
+            fields = {"cell": cell.name, "attempt": cell.attempts,
+                      "restart": cell.restarts}
+            warm = self._warm_manifest(cell)
+            if warm is not None:
+                # expectation on record: this (re)launch should hit warm
+                fields["prewarm_manifest"] = warm
+            self._event("cell_launch", **fields)
 
         def kill(proc) -> None:
             try:

@@ -154,7 +154,12 @@ class AdaptationEngine:
         state, _ = ckpt.load_for_inference(save_dir, checkpoint_idx)
         # serving knobs come from the (possibly overridden) run config even
         # when the caller supplies a pre-built system
-        return cls(system or MAMLSystem(cfg), state, serving_cfg=cfg.serving)
+        engine = cls(system or MAMLSystem(cfg), state, serving_cfg=cfg.serving)
+        # prewarm() can reach the run's executable store: a freshly spawned
+        # replica deserializes the stored serving executables instead of
+        # tracing+compiling the grid (compile/aot.py)
+        engine.save_dir = save_dir
+        return engine
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -202,6 +207,75 @@ class AdaptationEngine:
                     fn = self.compile_ledger.wrap_build(("serve_predict",) + key, fn)
                 self._predict_jit[key] = fn
         return fn
+
+    def prewarm(
+        self,
+        max_workers: Optional[int] = None,
+        compile_timeout_s: Optional[float] = None,
+        image_shape: Optional[Tuple[int, int, int]] = None,
+        on_program=None,
+        store=None,
+    ) -> Dict[str, Any]:
+        """AOT-compile the full serving grid — the exact
+        ``serving_planned_programs`` set the strict guard pins: (adapt |
+        predict) x shape bucket x task-batch bucket — before the first
+        request, through the compile ledger (``phase="prewarm"``), nothing
+        executed. THE cold-start killer for a fresh replica: after this,
+        every in-plan request dispatches into an already-compiled
+        executable. ``image_shape`` overrides the config's dataset shape
+        for engines serving hand-built models. Returns the prewarm summary
+        (programs, seconds, persistent-cache/store hits, per-program table).
+
+        An engine built by :meth:`from_run_dir` defaults ``store`` to the
+        run's executable store (``saved_models/executables/``) when
+        ``Config.aot.executable_store`` is on: a fresh replica deserializes
+        the stored serving executables — no tracing, no XLA — with loads
+        gated on the manifest fingerprint (a jaxlib/device-kind change
+        falls back to a cold compile instead of stale artifacts)."""
+        from ..compile.aot import prewarm_serving
+
+        aot_cfg = getattr(self.cfg, "aot", None)
+        # default store only when AOT is actually enabled: a read-only
+        # consumer (loadgen warmup, a bench) prewarming an aot-disabled run
+        # must never mutate its run dir
+        if (
+            store is None
+            and getattr(self, "save_dir", None)
+            and getattr(aot_cfg, "enabled", False)
+            and getattr(aot_cfg, "executable_store", False)
+        ):
+            from ..compile.aot import (
+                ENVIRONMENT_FIELDS,
+                ExecutableStore,
+                verify_manifest,
+            )
+            from ..experiment.checkpoint import load_prewarm_manifest
+
+            # the engine compiles single-device programs regardless of the
+            # training mesh, so only the environment fields gate loads (a
+            # replica with fewer visible devices than the training host
+            # still loads the serving executables it stored)
+            expected_warm, _ = verify_manifest(
+                load_prewarm_manifest(self.save_dir),
+                mesh_shape=None,
+                fields=ENVIRONMENT_FIELDS,
+            )
+            store = ExecutableStore(
+                os.path.join(self.save_dir, "executables"),
+                allow_load=expected_warm,
+            )
+        return prewarm_serving(
+            self,
+            max_workers=max_workers
+            if max_workers is not None
+            else getattr(aot_cfg, "max_workers", 4),
+            compile_timeout_s=compile_timeout_s
+            if compile_timeout_s is not None
+            else getattr(aot_cfg, "compile_timeout_s", 3600.0),
+            image_shape=image_shape,
+            on_program=on_program,
+            store=store,
+        )
 
     def compile_counts(self) -> Dict[str, Any]:
         with self._jit_lock:

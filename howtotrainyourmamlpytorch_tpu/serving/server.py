@@ -148,6 +148,30 @@ class ServingFrontend:
         )
         self._started = time.monotonic()
         self._closed = False
+        # --- AOT prewarm (Config.aot; compile/aot.py) --------------------
+        # compile the full (bucket x batch-bucket) serving grid before (or,
+        # background, while) the frontend accepts work: /healthz answers
+        # 503 "warming" until the set is compiled — DISTINCT from the
+        # breaker's "degraded" — so an orchestrator holds traffic off a
+        # replica that would eat cold XLA compiles on its first requests.
+        self._prewarm_lock = threading.Lock()
+        self._prewarm: Dict[str, Any] = {"status": "disabled"}
+        self._prewarm_thread: Optional[threading.Thread] = None
+        aot_cfg = getattr(engine.cfg, "aot", None)
+        if (
+            aot_cfg is not None
+            and getattr(aot_cfg, "enabled", False)
+            and hasattr(self.engine, "prewarm")
+        ):
+            with self._prewarm_lock:
+                self._prewarm = {"status": "warming"}
+            if getattr(aot_cfg, "serving_background", True):
+                self._prewarm_thread = threading.Thread(
+                    target=self._run_prewarm, name="serving-prewarm", daemon=True
+                )
+                self._prewarm_thread.start()
+            else:
+                self._run_prewarm()
         # wedge watchdogs over the batcher flush workers (poll mode): work
         # pending (queued or mid-flush) with zero completed flushes across
         # the whole deadline means that worker is parked in a hung device
@@ -174,6 +198,53 @@ class ServingFrontend:
                 )
                 wd.arm(batcher.name)
                 self._watchdogs.append(wd)
+
+    def _run_prewarm(self) -> None:
+        """Compile the planned serving grid (engine.prewarm) and publish the
+        outcome. Runs on the background prewarm thread (or inline when
+        ``aot.serving_background=false``); a failure degrades to lazy
+        compiles with the error visible in /metrics, never a dead server."""
+        t0 = time.monotonic()
+        try:
+            summary = self.engine.prewarm()
+            result = {
+                "status": "warm",
+                "programs": summary["programs"],
+                "seconds": summary["seconds"],
+                "cache_hits": summary["cache_hits"],
+                "store_hits": summary.get("store_hits", 0),
+                "compile_errors": summary["errors"],
+            }
+        except Exception as exc:  # noqa: BLE001 — prewarm must not kill serving
+            result = {
+                "status": "error",
+                "seconds": round(time.monotonic() - t0, 3),
+                "error": f"{type(exc).__name__}: {exc}",
+            }
+        with self._prewarm_lock:
+            self._prewarm = result
+        print(
+            f"serving prewarm: {result['status']} in {result['seconds']}s"
+            + (
+                f" ({result['programs']} programs, "
+                f"{result['cache_hits']} persistent-cache hits)"
+                if result["status"] == "warm"
+                else f" ({result.get('error')})"
+            ),
+            flush=True,
+        )
+
+    def prewarm_status(self) -> Dict[str, Any]:
+        with self._prewarm_lock:
+            return dict(self._prewarm)
+
+    def wait_prewarm(self, timeout_s: float = 600.0) -> Dict[str, Any]:
+        """Block until the background prewarm settles (bounded), then return
+        its status — the readiness hook for supervisors and tests."""
+        thread = self._prewarm_thread
+        if thread is not None:
+            thread.join(timeout=timeout_s)
+        return self.prewarm_status()
 
     def _on_wedge(self, info: Dict[str, Any]) -> None:
         """Serving wedge post-mortem: one structured JSON line + per-thread
@@ -319,9 +390,18 @@ class ServingFrontend:
         # OPERATIONS.md "Degraded modes".
         breaker_state = self.breaker.state
         degraded = [] if breaker_state == "closed" else [f"breaker_{breaker_state}"]
+        prewarm = self.prewarm_status()
+        # "warming" is its own state, not a degradation: the replica is
+        # healthy but would eat cold XLA compiles — the HTTP layer 503s it
+        # (like breaker-open) so orchestrators hold traffic until warm
+        if prewarm["status"] == "warming":
+            status = "warming"
+        else:
+            status = "degraded" if degraded else "ok"
         return {
-            "status": "degraded" if degraded else "ok",
+            "status": status,
             "degraded": degraded,
+            "prewarm": prewarm,
             "breaker": self.breaker.snapshot(),
             "platform": jax.default_backend(),
             "checkpoint_fingerprint": self.engine.fingerprint,
@@ -333,6 +413,7 @@ class ServingFrontend:
 
     def metrics(self) -> Dict[str, Any]:
         return {
+            "prewarm": self.prewarm_status(),
             "latency": self.latency.summary(),
             "cache": self.cache.stats(),
             "adapt_batcher": self._adapt_batcher.stats(),
@@ -398,12 +479,18 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/healthz":
                 health = frontend.healthz()
-                # 503 only while the breaker is OPEN, so load balancers
-                # drain without parsing the body; half-open must keep
-                # receiving traffic (probes are real requests) or the
-                # breaker could never close — the body still says exactly
-                # what is degraded
-                code = HTTP_UNAVAILABLE if "breaker_open" in health["degraded"] else 200
+                # 503 while the breaker is OPEN (drain a failing device) or
+                # while the AOT prewarm is still compiling (hold traffic off
+                # a cold replica — body status "warming", distinct from
+                # "degraded"); half-open must keep receiving traffic
+                # (probes are real requests) or the breaker could never
+                # close — the body still says exactly what is degraded
+                code = (
+                    HTTP_UNAVAILABLE
+                    if "breaker_open" in health["degraded"]
+                    or health["status"] == "warming"
+                    else 200
+                )
                 self._send_json(code, health)
             elif self.path == "/metrics":
                 self._send_json(200, frontend.metrics())

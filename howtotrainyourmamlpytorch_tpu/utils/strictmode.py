@@ -101,6 +101,12 @@ class RecompileGuard:
         # the guard into an XLA compile on the second attempt
         self._rejected: Dict[Any, str] = {}
         self.violations: List[str] = []
+        # AOT prewarm (compile/aot.py) flips the contract from "detect
+        # drift" to "enforce the prewarmed set": once mark_prewarmed() has
+        # declared the family fully compiled, ANY first-noted key — planned
+        # or not, within budget or not — is a finding, because nothing
+        # should be paying an XLA compile after prewarm claimed completeness
+        self._prewarmed = False
 
     # ------------------------------------------------------------------
 
@@ -129,7 +135,13 @@ class RecompileGuard:
                 msg = None
             if msg is None:
                 problem = None
-                if self.planned is not None and key not in self.planned:
+                if self._prewarmed:
+                    problem = (
+                        f"program {key!r} compiled OUTSIDE prewarm (the "
+                        f"prewarmed set of {len(self._seen)} programs was "
+                        f"declared complete)"
+                    )
+                elif self.planned is not None and key not in self.planned:
                     problem = (
                         f"unplanned program {key!r} (planned family: "
                         f"{sorted(map(repr, self.planned))})"
@@ -152,12 +164,28 @@ class RecompileGuard:
         if self.strict:
             raise RecompileBudgetExceededError(msg)
 
+    def mark_prewarmed(self) -> None:
+        """Declare the seen set complete (the AOT prewarm just compiled the
+        whole planned family): from here on a first-noted key of ANY kind is
+        a violation — the guard's contract flips from "detect drift" to
+        "enforce the prewarmed set"."""
+        with self._lock:
+            self._prewarmed = True
+
+    @property
+    def prewarmed(self) -> bool:
+        with self._lock:
+            return self._prewarmed
+
     def reset(self) -> None:
         """Forget seen programs (a deliberate cache drop, e.g. the rollback
-        LR backoff rebuilding the optimizer, re-plans the same family)."""
+        LR backoff rebuilding the optimizer, re-plans the same family —
+        which also un-seals a prewarmed guard: the recompiles after the drop
+        are deliberate, and a re-prewarm may re-seal)."""
         with self._lock:
             self._seen.clear()
             self._rejected.clear()
+            self._prewarmed = False
 
     def check(self) -> None:
         """Raise if any violation was recorded (useful with strict=False)."""
@@ -172,6 +200,7 @@ class RecompileGuard:
                 "name": self.name,
                 "budget": self.budget,
                 "lowerings": len(self._seen),
+                "prewarmed": self._prewarmed,
                 "violations": list(self.violations),
             }
 

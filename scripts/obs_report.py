@@ -257,6 +257,12 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
     if hbm is not None:
         report["hbm"] = hbm
 
+    # cold start (runner gauge: init -> first settled step) — the number
+    # the AOT prewarm exists to shrink
+    gauges = last.get("gauges") or {}
+    if gauges.get("cold_start_s") is not None:
+        report["cold_start_s"] = gauges["cold_start_s"]
+
     # compile tax (logs/compile_ledger.jsonl), scoped to the reported
     # session when the entries carry session ids
     ledger_path = os.path.join(logs_dir, "compile_ledger.jsonl")
@@ -269,6 +275,27 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
         report["compile_tax"] = _aggregate_compile_ledger(scoped or entries)
         if not scoped and entries:
             report["compile_tax"]["all_sessions"] = True
+        # the prewarm slice of the tax: entries the AOT prewarm paid
+        # (phase="prewarm") BEFORE the first step, vs compiles that leaked
+        # into the run proper
+        prewarmed = [e for e in (scoped or entries) if e.get("phase") == "prewarm"]
+        if prewarmed:
+            report["prewarm"] = {
+                "programs": len({e.get("program") for e in prewarmed}),
+                "seconds": round(sum(e.get("total_s") or 0.0 for e in prewarmed), 3),
+                "cache_hits": sum(
+                    1
+                    for e in prewarmed
+                    if (e.get("persistent_cache") or {}).get("hit")
+                ),
+                # deserialized straight from the executable store: skipped
+                # tracing AND XLA (the deepest warm tier)
+                "store_hits": sum(
+                    1
+                    for e in prewarmed
+                    if (e.get("executable_store") or {}).get("hit")
+                ),
+            }
 
     # host-phase coverage vs the SAME session's epoch wall-clock (the
     # honesty check)
@@ -326,6 +353,8 @@ def oneline(report: Dict[str, Any]) -> str:
         "epochs": report.get("epochs"),
         "episodes_per_s": report.get("episodes_per_s"),
         "mfu": report.get("mfu"),
+        "cold_start_s": report.get("cold_start_s"),
+        "prewarm_s": (report.get("prewarm") or {}).get("seconds"),
         "compile_tax_s": compile_tax.get("total_s"),
         "peak_hbm_gib": hbm.get("peak_gib"),
         "phase_coverage": report.get("phase_coverage"),
@@ -460,6 +489,18 @@ def render_human(report: Dict[str, Any]) -> str:
             )
     if report.get("mfu") is not None:
         lines.append(f"live MFU (last snapshot): {report['mfu']}")
+    if report.get("cold_start_s") is not None:
+        prewarm = report.get("prewarm")
+        lines.append(
+            f"cold start {report['cold_start_s']}s (init -> first settled step)"
+            + (
+                f"; prewarm compiled {prewarm['programs']} programs in "
+                f"{prewarm['seconds']}s ({prewarm.get('store_hits', 0)} store hits, "
+                f"{prewarm['cache_hits']} cache hits)"
+                if prewarm
+                else ""
+            )
+        )
     tax = report.get("compile_tax")
     if tax:
         lines.append(

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""AOT prewarm service: compile the planned program set ahead of time.
+
+Builds the exact program families strict mode plans — the train variants
+``(single|multi, second_order, msl)`` plus eval, and/or the serving
+(bucket x batch-bucket) grid — lowers and compiles them through the compile
+ledger (every compile timed, ``phase="prewarm"``), persists the XLA
+artifacts in the persistent compilation cache (``utils/compcache.py``), and
+writes the executable-store manifest next to the checkpoints so a restarted
+run, a fleet relaunch, or a freshly spawned serving replica can verify it
+will hit warm before accepting work. Prints exactly ONE JSON line (the
+``bench.py`` contract); progress goes to stderr.
+
+Usage::
+
+    # warm a run dir's train programs (fresh fleets run this before work):
+    JAX_PLATFORMS=cpu python scripts/prewarm.py exps/<run>
+
+    # warm the serving grid too (replica spawn):
+    python scripts/prewarm.py exps/<run> --serving
+
+    # no run dir: prewarm a config built from overrides alone
+    python scripts/prewarm.py --no-train --serving num_classes_per_set=5
+
+Exit codes: 0 = prewarmed (manifest written), 2 = usage error. Per-program
+compile failures are contained and counted in the JSON line — a partially
+warm cache still beats a cold one.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "run_dir", nargs="?", default=None,
+        help="experiment directory (config.yaml + saved_models); omit to "
+        "prewarm a config built purely from overrides",
+    )
+    parser.add_argument("--serving", action="store_true",
+                        help="also prewarm the serving (bucket x batch-bucket) grid")
+    parser.add_argument("--no-train", action="store_true",
+                        help="skip the train program family")
+    parser.add_argument("--max-workers", type=int, default=None,
+                        help="compile-pool width (default: config aot.max_workers)")
+    parser.add_argument("overrides", nargs="*", default=[],
+                        help="config overrides, key=value dotted paths")
+    args = parser.parse_args(argv)
+    if args.run_dir and "=" in args.run_dir:
+        # overrides-only invocation: argparse hands the first key=value to
+        # the optional run_dir positional — put it back
+        args.overrides.insert(0, args.run_dir)
+        args.run_dir = None
+    if args.no_train and not args.serving:
+        print("prewarm: nothing to do (--no-train without --serving)", file=sys.stderr)
+        return 2
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # a site hook may override platform selection after capturing the
+        # env; re-assert the user's choice (the serve.py pattern)
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from howtotrainyourmamlpytorch_tpu.compile import aot
+    from howtotrainyourmamlpytorch_tpu.config import load_config
+    from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+    from howtotrainyourmamlpytorch_tpu.experiment import checkpoint as ckpt
+    from howtotrainyourmamlpytorch_tpu.parallel import (
+        batch_sharding,
+        chunk_sharding,
+        make_mesh,
+        shard_train_state,
+    )
+
+    yaml_path = None
+    if args.run_dir:
+        yaml_path = os.path.join(args.run_dir, "config.yaml")
+        if not os.path.exists(yaml_path):
+            print(f"prewarm: no config.yaml under {args.run_dir}", file=sys.stderr)
+            return 2
+    cfg = load_config(yaml_path, args.overrides)
+    cache_dir = aot.ensure_persistent_cache(cfg)
+    _log(f"prewarm: persistent cache at {cache_dir}")
+
+    t0 = time.perf_counter()
+    system = MAMLSystem(cfg)
+    state = system.init_train_state()
+
+    # mesh parity: a run that trains on a dp x mp mesh compiles programs
+    # with those shardings baked in — prewarm must match or it warms the
+    # wrong executables. Mirrors the runner's mesh construction; on any
+    # infeasibility (batch not divisible, single device) fall back to the
+    # meshless programs with a logged note.
+    mesh = None
+    b_sharding = c_sharding = None
+    mesh_shape = [1, 1]
+    if cfg.parallel.shard_meta_batch and len(jax.devices()) > 1:
+        try:
+            mesh = make_mesh(cfg.parallel)
+            global_batch = cfg.batch_size * cfg.samples_per_iter
+            if global_batch % mesh.shape["dp"] != 0:
+                raise ValueError(
+                    f"meta-batch {global_batch} not divisible by dp={mesh.shape['dp']}"
+                )
+            state = shard_train_state(state, mesh, tp_convs=cfg.parallel.tp_convs)
+            b_sharding, c_sharding = batch_sharding(mesh), chunk_sharding(mesh)
+            mesh_shape = [int(mesh.shape["dp"]), int(mesh.shape.get("mp", 1))]
+        except Exception as exc:  # noqa: BLE001 — degrade to meshless programs
+            _log(f"prewarm: meshless fallback ({type(exc).__name__}: {exc})")
+            mesh = None
+            b_sharding = c_sharding = None
+
+    save_dir = os.path.join(args.run_dir, "saved_models") if args.run_dir else None
+    store = None
+    if save_dir:
+        expected_warm, reason = aot.verify_manifest(
+            ckpt.load_prewarm_manifest(save_dir), mesh_shape
+        )
+        _log(
+            "prewarm: manifest promises a warm start"
+            if expected_warm
+            else f"prewarm: cold start expected ({reason})"
+        )
+        if cfg.aot.executable_store:
+            # stored executables deserialize (no tracing, no XLA); loads
+            # gated on the manifest verdict so a changed environment
+            # compiles cold instead of loading stale artifacts
+            store = aot.ExecutableStore(
+                os.path.join(save_dir, "executables"), allow_load=expected_warm
+            )
+
+    train_summary = serving_summary = None
+    if not args.no_train:
+        _log("prewarm: compiling the train program family...")
+        train_summary = system.prewarm(
+            state,
+            batch_sharding=b_sharding,
+            chunk_sharding=c_sharding,
+            max_workers=args.max_workers,
+            on_program=lambda name: _log(f"prewarm:   {name}"),
+            store=store,
+        )
+    if args.serving:
+        from howtotrainyourmamlpytorch_tpu.serving import AdaptationEngine
+
+        _log("prewarm: compiling the serving grid...")
+        engine = AdaptationEngine(system, state)
+        serving_summary = engine.prewarm(
+            max_workers=args.max_workers,
+            on_program=lambda name: _log(f"prewarm:   {name}"),
+            store=store,
+        )
+
+    manifest_path = None
+    if save_dir and cfg.aot.executable_store:
+        manifest_path = ckpt.save_prewarm_manifest(
+            save_dir,
+            aot.build_manifest(
+                train_summary=train_summary,
+                serving_summary=serving_summary,
+                mesh_shape=mesh_shape,
+                store=store,
+            ),
+        )
+
+    def slim(summary):
+        if summary is None:
+            return None
+        return {k: v for k, v in summary.items() if k != "by_program"}
+
+    report = {
+        "report": "prewarm",
+        "platform": jax.default_backend(),
+        "run_dir": args.run_dir,
+        "seconds": round(time.perf_counter() - t0, 3),
+        "train": slim(train_summary),
+        "serving": slim(serving_summary),
+        "cache_dir": cache_dir,
+        "manifest": manifest_path,
+    }
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

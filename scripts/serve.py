@@ -67,6 +67,18 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     frontend = build_frontend(args.run_dir, args.checkpoint, args.overrides)
+    # AOT prewarm (Config.aot): the frontend is already compiling the full
+    # (bucket x batch-bucket) grid; /healthz answers 503 "warming" until it
+    # finishes, and the frontend prints "serving prewarm: warm in <s>s"
+    # with the duration + persistent-cache hit count when it lands.
+    aot_cfg = frontend.engine.cfg.aot
+    if aot_cfg.enabled:
+        mode = "background" if aot_cfg.serving_background else "blocking"
+        print(
+            f"prewarm: compiling the planned serving grid ({mode}); "
+            "/healthz reports 'warming' until warm",
+            flush=True,
+        )
     serving = frontend.engine.serving
     host = args.host if args.host is not None else serving.host
     port = args.port if args.port is not None else serving.port

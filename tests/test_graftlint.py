@@ -651,6 +651,28 @@ def test_self_gate_covers_perf_obs_paths_explicitly():
     )
 
 
+def test_self_gate_covers_aot_paths_explicitly():
+    """The AOT prewarm subsystem (ISSUE 8) sits inside the self-gate on its
+    own terms: the warm pool is threaded (GL201/GL202 territory — bounded
+    ``fut.result`` timeouts, lock-guarded store counters), and the prewarm
+    CLI is an entry point with its own exit codes — zero unsuppressed
+    findings even if the top-level path list is ever restructured."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join("howtotrainyourmamlpytorch_tpu", "compile"),
+                os.path.join("scripts", "prewarm.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in AOT paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_catches_an_introduced_true_positive(tmp_path):
     """End-to-end: drop one fixture true positive next to real package code
     and the CLI must exit 1 with a GL id on stdout."""

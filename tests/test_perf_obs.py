@@ -532,33 +532,20 @@ def test_run_load_unresolved_request_costs_grace_not_report():
     assert not report["stairs"][0]["slo_met"] and report["value"] is None
 
 
-def test_warmup_compiles_batch_bucket_grid():
-    """The MicroBatcher flushes task-batches under concurrency, so warmup
-    must compile the (bucket x batch-bucket) grid up front — a cold
-    serve_predict/(bucket, b>1) compile inside a measured stair would
-    poison that stair's p99."""
-    assert slo._batch_buckets(8) == [1, 2, 4, 8]
-    assert slo._batch_buckets(6) == [1, 2, 4, 6]
-    assert slo._batch_buckets(1) == [1]
+def test_warmup_delegates_to_engine_prewarm():
+    """Pre-clock warmup must compile the full planned (bucket x
+    batch-bucket) grid — a cold serve_predict/(bucket, b>1) compile inside
+    a measured stair would poison that stair's p99. The grid logic lives in
+    ``AdaptationEngine.prewarm()`` (compile/aot.py) now; loadgen's warmup
+    DELEGATES instead of duplicating it."""
 
     class _Engine:
-        class serving:
-            max_batch_size = 4
-
         def __init__(self):
-            self.calls = []
+            self.prewarm_calls = 0
 
-        def adapt(self, x, y):
-            self.calls.append(("adapt", 1))
-            return {"w": None}
-
-        def adapt_batch(self, items):
-            self.calls.append(("adapt", len(items)))
-            return [{"w": None}] * len(items)
-
-        def predict_batch(self, items):
-            self.calls.append(("predict", len(items)))
-            return [None] * len(items)
+        def prewarm(self, **kwargs):
+            self.prewarm_calls += 1
+            return {"programs": 8, "seconds": 0.5, "cache_hits": 3, "errors": 0}
 
     class _Frontend:
         engine = None
@@ -567,21 +554,37 @@ def test_warmup_compiles_batch_bucket_grid():
     frontend.engine = _Engine()
     schedule = [
         slo.Request(t=0.0, kind="predict", episode_seed=0, n_query=5, stair=0),
-        slo.Request(t=0.1, kind="predict", episode_seed=1, n_query=15, stair=0),
     ]
+    logged = []
     slo._warm_batch_buckets(
-        frontend, schedule, lambda s: (None, None), lambda s, n: n, lambda m: None
+        frontend, schedule, lambda s: (None, None), lambda s, n: n, logged.append
     )
-    calls = frontend.engine.calls
-    # every >1 batch bucket per kind; both scheduled query sizes for predict
-    assert ("adapt", 2) in calls and ("adapt", 4) in calls
-    assert calls.count(("predict", 2)) == 2 and calls.count(("predict", 4)) == 2
+    assert frontend.engine.prewarm_calls == 1
+    assert any("prewarmed 8 serving programs" in m for m in logged)
     # a frontend without an engine (test double) degrades to a logged skip
     logged = []
     slo._warm_batch_buckets(
         object(), schedule, lambda s: (None, None), lambda s, n: n, logged.append
     )
     assert any("skipped" in m for m in logged)
+    # ... as does an engine-shaped double without a prewarm method
+    logged = []
+    frontend.engine = object()
+    slo._warm_batch_buckets(
+        frontend, schedule, lambda s: (None, None), lambda s, n: n, logged.append
+    )
+    assert any("skipped" in m for m in logged)
+    # a prewarm failure is contained: logged, never raised into the test
+    class _Broken:
+        def prewarm(self, **kwargs):
+            raise RuntimeError("device on fire")
+
+    logged = []
+    frontend.engine = _Broken()
+    slo._warm_batch_buckets(
+        frontend, schedule, lambda s: (None, None), lambda s, n: n, logged.append
+    )
+    assert any("warmup failed" in m for m in logged)
 
 
 # ---------------------------------------------------------------------------
