@@ -50,6 +50,28 @@ except Exception:  # registry unreadable: the historical literal still holds
     _RC_USAGE = 2
 
 REFERENCE_STEPS_PER_SEC = 2.6  # fastest plausible single-GPU reference (see docstring)
+
+
+def _precision_overrides(knob: str) -> dict:
+    """Config kwargs for the BENCH_PRECISION A/B knob, so one armed chip
+    session can measure f32 vs bf16 on the same queue:
+
+    - ``""``/``"legacy"`` (default): the flagship recipe exactly as before
+      this knob existed — legacy ``compute_dtype="bfloat16"`` per-forward
+      casts (the JSON line stays comparable to prior rounds);
+    - ``"f32"``: full float32;
+    - ``"bf16"``: the principled bf16 inner loop with f32 meta-accumulation
+      (``Config.precision``, ops/precision.py).
+    """
+    if knob in ("", "legacy"):
+        return {"compute_dtype": "bfloat16"}
+    if knob == "f32":
+        return {"compute_dtype": "float32"}
+    if knob == "bf16":
+        return {"compute_dtype": "bfloat16", "precision": {"enabled": True}}
+    raise ValueError(
+        f"BENCH_PRECISION must be '', 'legacy', 'f32' or 'bf16', got {knob!r}"
+    )
 STARTUP_TIMEOUT_S = float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", 90.0))
 # The axon tunnel wedges for minutes-to-hours at a time (server-side). A
 # single in-process init attempt cannot be retried (backend init happens once
@@ -357,13 +379,19 @@ def main():
     # enabler) on a single chip: same math, explicit im2col + dot instead of
     # the native conv — quantifies what the TP-capable program family costs
     # (or saves) when the MXU runs the GEMM explicitly.
+    # BENCH_PRECISION=f32|bf16|legacy A/Bs the mixed-precision inner loop
+    # (ops/precision.py) against full f32 and the legacy per-forward cast
+    # in one armed session; the default keeps the recipe unchanged.
     cfg = Config(
-        compute_dtype="bfloat16",
         remat_inner_steps=False,
         matmul_precision=os.environ.get("BENCH_MATMUL_PRECISION", "default"),
         conv_via_patches=os.environ.get("BENCH_CONV_VIA_PATCHES", "0") == "1",
+        **_precision_overrides(os.environ.get("BENCH_PRECISION", "")),
     )
     system = MAMLSystem(cfg)
+    # program-variant marker, same contract as matmul_precision above: the
+    # resolved policy name ("legacy_bf16" | "f32" | "bf16_inner")
+    wd.update(precision=system.precision.name)
     # collector-only compile ledger: every XLA compile this process pays is
     # timed and attributed, so the JSON line's `prewarm` breakdown (compile
     # tax: programs / seconds / persistent-cache hits) is a tracked number

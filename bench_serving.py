@@ -61,10 +61,20 @@ def main(argv=None) -> int:
 
     img = (28, 28, 1)
     support = args.n_way * args.k_shot
+    # BENCH_PRECISION knob, same contract as bench.py: "" = the recipe as
+    # before (f32 here), "bf16" = the principled policy (ops/precision.py),
+    # "f32"/"legacy" explicit — so the armed chip queue can A/B the serving
+    # path's precision in the same session as the train bench.
+    knob = os.environ.get("BENCH_PRECISION", "")
+    if knob not in ("", "legacy", "f32", "bf16"):
+        print(f"bench_serving: bad BENCH_PRECISION {knob!r}", file=sys.stderr)
+        return 2
     cfg = Config(
         num_classes_per_set=args.n_way,
         num_samples_per_class=args.k_shot,
         num_target_samples=max(args.n_query // args.n_way, 1),
+        compute_dtype="bfloat16" if knob == "legacy" else "float32",
+        precision={"enabled": knob == "bf16"},
         serving=ServingConfig(
             support_buckets=[support], query_buckets=[args.n_query],
             max_batch_size=args.batch,
@@ -166,6 +176,9 @@ def main(argv=None) -> int:
         "n_query": args.n_query,
         "micro_batch": args.batch,
         "model": f"vgg{stages}x{filters}",
+        # resolved policy name ("f32" | "legacy_bf16" | "bf16_inner") — a
+        # capture from a precision arm must never read as the default number
+        "precision": system.precision.name,
         "compiled": engine.compile_counts(),
         "phase_breakdown": {
             name: {"p50_ms": s["p50_ms"], "p95_ms": s["p95_ms"]}

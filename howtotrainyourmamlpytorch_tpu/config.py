@@ -195,6 +195,46 @@ class ObservabilityConfig:
 
 
 @dataclass
+class PrecisionConfig:
+    """Mixed-precision policy knobs (``ops/precision.py``; ROADMAP item 3 —
+    the 8%-MFU gap). Off (the default), every cast helper is the identity
+    and training/serving are bit-identical to a build without the subsystem;
+    the legacy top-level ``compute_dtype`` knob keeps its exact pre-policy
+    per-forward-cast semantics. Enabled, the inner loop runs the principled
+    bf16 policy: f32 master params/LSLR lrs in the TrainState, fast weights
+    and inner forward/backward/update in ``compute_dtype`` (cast once at
+    rollout entry), BN statistics and loss reductions in ``stat_dtype``,
+    MSL-weighted outer loss and outer Adam in f32."""
+
+    enabled: bool = False
+    # inner-loop compute dtype when enabled ("bfloat16" | "float32";
+    # float32 degenerates to the plain path — an A/B convenience)
+    compute_dtype: str = "bfloat16"
+    # BN-statistics / normalization reduction dtype: "float32" (the policy's
+    # point) or "compute" (stats in the compute dtype — the A/B lever for
+    # pricing what f32 statistics cost)
+    stat_dtype: str = "float32"
+    # Fold the BN scale/shift into the patches-GEMM epilogue for conv->BN
+    # layers (models/layers.py::conv2d_bn_patches): one fat GEMM + one
+    # fused multiply-add instead of conv then a 4-op normalize chain. Same
+    # math up to f.p. reassociation (parity-tested); vgg backbone only.
+    # Requires conv_via_patches (auto-enabled, mirroring parallel.tp_convs).
+    fuse_conv_bn: bool = False
+
+    def __post_init__(self):
+        if self.compute_dtype not in ("bfloat16", "float32"):
+            raise ValueError(
+                f"precision.compute_dtype must be 'bfloat16' or 'float32', "
+                f"got {self.compute_dtype!r}"
+            )
+        if self.stat_dtype not in ("float32", "compute"):
+            raise ValueError(
+                f"precision.stat_dtype must be 'float32' or 'compute', "
+                f"got {self.stat_dtype!r}"
+            )
+
+
+@dataclass
 class AotConfig:
     """AOT prewarm knobs (``compile/aot.py``; ROADMAP item 2 — kill the
     compile tax). Enabled, the runner and serving frontend lower+compile the
@@ -442,6 +482,16 @@ class Config:
             # conv path; the patches-GEMM form is a strict requirement, so
             # enable it rather than bounce the config back
             self.conv_via_patches = True
+        # direct Config(precision={...}) construction (bench A/B knobs) hands
+        # the nested block over as a plain dict — same coercion the
+        # resilience block does for its watchdog
+        if isinstance(self.precision, dict):
+            self.precision = PrecisionConfig(**self.precision)
+        if self.precision.fuse_conv_bn and not self.conv_via_patches:
+            # the fused conv->BN epilogue IS a patches-GEMM epilogue; enable
+            # the patches form rather than bounce the config back (the same
+            # policy tp_convs gets above)
+            self.conv_via_patches = True
 
     # --- episode shape (reference config.yaml:22-26) ---
     num_classes_per_set: int = 20
@@ -518,6 +568,8 @@ class Config:
     observability: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     # --- AOT prewarm (compile/ package; ROADMAP item 2) ---
     aot: AotConfig = field(default_factory=AotConfig)
+    # --- mixed precision (ops/precision.py; ROADMAP item 3) ---
+    precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     compute_dtype: str = "float32"  # or "bfloat16" for MXU-friendly compute
     remat_inner_steps: bool = True  # jax.checkpoint per inner step (SURVEY §5.7)
     # Fully unroll the inner-step lax.scan: removes scan sequencing overhead
@@ -709,8 +761,8 @@ def _dataclass_from_dict(cls, data: Dict[str, Any]):
         if name not in data:
             continue
         value = data[name]
-        if name in ("dataset", "inner_optim", "parallel", "serving", "resilience", "observability", "aot"):
-            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig, "serving": ServingConfig, "resilience": ResilienceConfig, "observability": ObservabilityConfig, "aot": AotConfig}[name]
+        if name in ("dataset", "inner_optim", "parallel", "serving", "resilience", "observability", "aot", "precision"):
+            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig, "serving": ServingConfig, "resilience": ResilienceConfig, "observability": ObservabilityConfig, "aot": AotConfig, "precision": PrecisionConfig}[name]
             presets = {"dataset": DATASET_PRESETS, "inner_optim": INNER_OPTIM_PRESETS}.get(name, {})
             if isinstance(value, str):
                 if value not in presets:

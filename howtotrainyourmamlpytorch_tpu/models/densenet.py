@@ -36,16 +36,16 @@ def _init_dense_layer(key, cin, growth_rate, bn_size):
 
 
 def _apply_dense_layer(params, state, x, use_batch_stats, update_running, via_patches=False,
-                       sample_weight=None):
+                       sample_weight=None, stat_dtype=None):
     out, n1_s = layers.batch_norm(
         params["norm1"], state["norm1"], x, use_batch_stats, update_running,
-        sample_weight=sample_weight,
+        sample_weight=sample_weight, stat_dtype=stat_dtype,
     )
     out = layers.relu(out)
     out = layers.conv2d(params["conv1"], out, stride=1, padding=0, via_patches=via_patches)
     out, n2_s = layers.batch_norm(
         params["norm2"], state["norm2"], out, use_batch_stats, update_running,
-        sample_weight=sample_weight,
+        sample_weight=sample_weight, stat_dtype=stat_dtype,
     )
     out = layers.relu(out)
     out = layers.conv2d(params["conv2"], out, stride=1, padding=1, via_patches=via_patches)
@@ -104,7 +104,7 @@ def build_densenet(
         return params, state
 
     def apply(params, state, x, *, use_batch_stats=True, update_running=False,
-              sample_weight=None):
+              sample_weight=None, stat_dtype=None):
         new_state = {}
         for i, num_layers in enumerate(block_config):
             bname = f"denseblock{i + 1}"
@@ -114,7 +114,7 @@ def build_densenet(
                 new_feat, ls = _apply_dense_layer(
                     params[bname][lname], state[bname][lname], x,
                     use_batch_stats, update_running, conv_via_patches,
-                    sample_weight,
+                    sample_weight, stat_dtype,
                 )
                 block_s[lname] = ls
                 x = jnp.concatenate([x, new_feat], axis=-1)
@@ -124,6 +124,7 @@ def build_densenet(
                 x, tn_s = layers.batch_norm(
                     params[tname]["norm"], state[tname]["norm"], x,
                     use_batch_stats, update_running, sample_weight=sample_weight,
+                    stat_dtype=stat_dtype,
                 )
                 x = layers.relu(x)
                 x = layers.conv2d(
@@ -134,7 +135,7 @@ def build_densenet(
                 new_state[tname] = {"norm": tn_s}
         x, n5_s = layers.batch_norm(
             params["norm5"], state["norm5"], x, use_batch_stats, update_running,
-            sample_weight=sample_weight,
+            sample_weight=sample_weight, stat_dtype=stat_dtype,
         )
         new_state["norm5"] = n5_s
         x = layers.relu(x)

@@ -137,16 +137,37 @@ def extract_patches(x, kh, kw, stride=1, padding=0):
     return jnp.stack(cols, axis=3)
 
 
-def conv2d_patches(params, x, stride=1, padding=0):
-    """conv2d expressed as patches x reshaped kernel (implicit GEMM made
-    explicit). Same math as :func:`conv2d` up to f.p. accumulation order; the
-    contraction runs over (tap, cin) jointly so GSPMD can psum a
+def _patches_gemm(x, w, stride=1, padding=0):
+    """The conv as ONE explicit GEMM: patches are extracted once per input
+    shape and collapsed to a [N, Ho*Wo, kh*kw*cin] matrix, contracted with
+    the [kh*kw*cin, cout] kernel matrix by a single ``lax.dot_general`` —
+    every output position of every sample rides one fat contraction instead
+    of a thin per-position/per-sample op population.
+
+    Under the meta-step's per-task ``vmap`` (adapted kernels differ per
+    task) BOTH operands gain the task axis, which becomes a dot_general
+    *batching* dimension: the whole (task x sample x position) population is
+    one large batched GEMM per layer — the MXU-shaped form of this program
+    family. The contraction runs over (tap, cin) jointly so GSPMD can psum a
     channel-sharded input against the matching kernel rows instead of
     re-gathering (Megatron row-parallel pattern, automatic here)."""
-    w = params["w"]
     kh, kw, cin, cout = w.shape
     p = extract_patches(x, kh, kw, stride, padding)
-    out = jnp.einsum("nxykc,kcd->nxyd", p, w.reshape(kh * kw, cin, cout))
+    n, ho, wo = p.shape[:3]
+    lhs = p.reshape(n, ho * wo, kh * kw * cin)
+    out = lax.dot_general(
+        lhs,
+        w.reshape(kh * kw * cin, cout),
+        dimension_numbers=(((2,), (0,)), ((), ())),
+    )
+    return out.reshape(n, ho, wo, cout)
+
+
+def conv2d_patches(params, x, stride=1, padding=0):
+    """conv2d expressed as patches x reshaped kernel (implicit GEMM made
+    explicit — see :func:`_patches_gemm` for the batched-GEMM structure).
+    Same math as :func:`conv2d` up to f.p. accumulation order."""
+    out = _patches_gemm(x, params["w"], stride, padding)
     if "b" in params:
         out = out + params["b"]
     return out
@@ -199,6 +220,34 @@ def init_batch_norm(c):
     return params, state
 
 
+def _batch_stats(x, axes, sample_weight):
+    """Per-channel batch mean/var in ``x``'s dtype (callers pick the
+    reduction precision by casting ``x`` first — the ``stat_dtype`` seam the
+    precision policy threads through the models). The weighted branch is the
+    shape-bucketing mask: statistics over real samples only."""
+    if sample_weight is None:
+        return jnp.mean(x, axis=axes), jnp.var(x, axis=axes)
+    w = sample_weight.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+    # per-channel element count: real samples x spatial positions
+    spatial = x.size // (x.shape[0] * x.shape[-1])
+    denom = jnp.maximum(jnp.sum(sample_weight) * spatial, 1.0)
+    mean = jnp.sum(w * x, axis=axes) / denom
+    var = jnp.sum(w * jnp.square(x - mean), axis=axes) / denom
+    return mean, var
+
+
+def _running_update(state, mean, var, n: int, momentum: float):
+    """EMA update of the running statistics (torch momentum convention,
+    unbiased var). The running state stays in its own (f32) dtype: ``mean``/
+    ``var`` may arrive in a wider stat dtype and promote cleanly."""
+    unbiased = var * (n / max(n - 1, 1))
+    return {
+        "mean": (1 - momentum) * state["mean"] + momentum * mean,
+        "var": (1 - momentum) * state["var"] + momentum * unbiased,
+        "count": state["count"] + 1,
+    }
+
+
 def batch_norm(
     params,
     state,
@@ -208,6 +257,7 @@ def batch_norm(
     momentum: float = 0.1,
     eps: float = 1e-5,
     sample_weight=None,
+    stat_dtype=None,
 ):
     """Functional batch-norm over NHWC (reduce N,H,W) or NC input (reduce N).
 
@@ -225,33 +275,89 @@ def batch_norm(
     shape bucket (serving/engine.py) normalizes exactly as the unpadded
     batch would — the enabler for transductive BN under shape bucketing.
     None keeps the unweighted reduction bit-for-bit identical to before.
+
+    ``stat_dtype`` (threaded by the precision policy, ops/precision.py)
+    computes the batch statistics and the normalization in that dtype — the
+    bf16 inner loop reduces its BN statistics in f32 — with the normalized
+    activations cast back to ``x``'s dtype before the (fast-weight) scale/
+    shift, so activations stay in the compute dtype. None (the default)
+    reduces in ``x``'s own dtype: the traced program is bit-identical to
+    before this parameter existed.
     """
     axes = tuple(range(x.ndim - 1))
+    sx = x if stat_dtype is None else x.astype(stat_dtype)
     if use_batch_stats:
-        if sample_weight is None:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
-        else:
-            w = sample_weight.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
-            # per-channel element count: real samples x spatial positions
-            spatial = x.size // (x.shape[0] * x.shape[-1])
-            denom = jnp.maximum(jnp.sum(sample_weight) * spatial, 1.0)
-            mean = jnp.sum(w * x, axis=axes) / denom
-            var = jnp.sum(w * jnp.square(x - mean), axis=axes) / denom
+        mean, var = _batch_stats(sx, axes, sample_weight)
     else:
         mean, var = state["mean"], state["var"]
+        if stat_dtype is not None:
+            mean, var = mean.astype(stat_dtype), var.astype(stat_dtype)
     inv = lax.rsqrt(var + eps)
-    out = (x - mean) * inv * params["scale"] + params["bias"]
+    if stat_dtype is None:
+        out = (x - mean) * inv * params["scale"] + params["bias"]
+    else:
+        out = ((sx - mean) * inv).astype(x.dtype) * params["scale"] + params["bias"]
     if update_running and use_batch_stats:
-        n = x.size // x.shape[-1]
-        unbiased = var * (n / max(n - 1, 1))
-        new_state = {
-            "mean": (1 - momentum) * state["mean"] + momentum * mean,
-            "var": (1 - momentum) * state["var"] + momentum * unbiased,
-            "count": state["count"] + 1,
-        }
+        new_state = _running_update(
+            state, mean, var, x.size // x.shape[-1], momentum
+        )
     else:
         new_state = state
+    return out, new_state
+
+
+def conv2d_bn_patches(
+    conv_params,
+    bn_params,
+    bn_state,
+    x,
+    stride: int = 1,
+    padding: int = 0,
+    *,
+    use_batch_stats: bool = True,
+    update_running: bool = False,
+    momentum: float = 0.1,
+    eps: float = 1e-5,
+    sample_weight=None,
+    stat_dtype=None,
+):
+    """Fused conv->BN: ONE patches-GEMM (:func:`_patches_gemm`) followed by a
+    single scale+shift epilogue. BN's ``(g - mean) * inv * scale + bias`` is
+    refactored to ``g * a + (bias - mean * a)`` with ``a = inv * scale``, so
+    after the (transductive) statistics are reduced, the normalize lands on
+    the GEMM output as one fused multiply-add instead of a sub/mul/mul/add
+    chain — fewer, fatter ops on the inner-rollout hot path. Same math as
+    ``conv2d_patches`` -> ``batch_norm`` up to f.p. reassociation
+    (parity-pinned by tests/test_precision.py, train and eval modes).
+
+    ``sample_weight`` / ``stat_dtype`` have :func:`batch_norm` semantics;
+    returns ``(out, new_bn_state)`` exactly like ``batch_norm``.
+    """
+    g = _patches_gemm(x, conv_params["w"], stride, padding)
+    if "b" in conv_params:
+        # the conv bias must be inside the statistics (it shifts the batch
+        # mean — and survives into eval mode's running stats)
+        g = g + conv_params["b"]
+    axes = tuple(range(g.ndim - 1))
+    sg = g if stat_dtype is None else g.astype(stat_dtype)
+    if use_batch_stats:
+        mean, var = _batch_stats(sg, axes, sample_weight)
+    else:
+        mean, var = bn_state["mean"], bn_state["var"]
+        if stat_dtype is not None:
+            mean, var = mean.astype(stat_dtype), var.astype(stat_dtype)
+    inv = lax.rsqrt(var + eps)
+    a = inv * bn_params["scale"]
+    shift = bn_params["bias"] - mean * a
+    out = sg * a + shift
+    if stat_dtype is not None:
+        out = out.astype(g.dtype)
+    if update_running and use_batch_stats:
+        new_state = _running_update(
+            bn_state, mean, var, g.size // g.shape[-1], momentum
+        )
+    else:
+        new_state = bn_state
     return out, new_state
 
 

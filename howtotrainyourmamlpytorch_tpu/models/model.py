@@ -24,3 +24,7 @@ class Model(NamedTuple):
     # or a silently wrong pooling convention.
     conv_via_patches: Optional[bool] = None
     reduce_window_pool: Optional[bool] = None
+    # fused conv->BN GEMM epilogue (Config.precision.fuse_conv_bn); None =
+    # unknown/not applicable (hand-built Model, or a backbone without the
+    # fused layer implemented)
+    fuse_conv_bn: Optional[bool] = None

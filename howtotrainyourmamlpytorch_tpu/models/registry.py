@@ -24,6 +24,7 @@ def build_model(
     num_classes: int,
     conv_via_patches: bool = False,
     reduce_window_pool: bool = False,
+    fuse_conv_bn: bool = False,
 ) -> Model:
     """``image_shape`` is (H, W, C) — NHWC, the TPU-native layout.
 
@@ -31,7 +32,9 @@ def build_model(
     enabler) and ``reduce_window_pool`` (Config.max_pool_reduce_window) are
     baked into the returned model's ``apply`` — explicit per-model
     parameters, not process globals, so concurrently-live systems trace
-    independent conventions."""
+    independent conventions. ``fuse_conv_bn`` (Config.precision.fuse_conv_bn)
+    folds BN into the patches-GEMM epilogue — implemented for the vgg
+    backbone (the flagship), rejected loudly elsewhere."""
     if net == "vgg":
         return build_vgg(
             image_shape,
@@ -43,6 +46,12 @@ def build_model(
             norm_layer="batch_norm",
             conv_via_patches=conv_via_patches,
             reduce_window_pool=reduce_window_pool,
+            fuse_conv_bn=fuse_conv_bn,
+        )
+    if fuse_conv_bn:
+        raise ValueError(
+            f"precision.fuse_conv_bn is implemented for the vgg backbone "
+            f"only (got net={net!r}); disable the fuse or use vgg"
         )
     if net in _RESNET_BLOCKS:
         return build_resnet(

@@ -46,19 +46,19 @@ def _init_basic_block(key, cin, planes, stride):
 
 def _apply_basic_block(
     params, state, x, stride, use_batch_stats, update_running, via_patches=False,
-    sample_weight=None,
+    sample_weight=None, stat_dtype=None,
 ):
     identity = x
     out = layers.conv2d(params["conv1"], x, stride=stride, padding=1, via_patches=via_patches)
     out, bn1_s = layers.batch_norm(
         params["bn1"], state["bn1"], out, use_batch_stats, update_running,
-        sample_weight=sample_weight,
+        sample_weight=sample_weight, stat_dtype=stat_dtype,
     )
     out = layers.relu(out)
     out = layers.conv2d(params["conv2"], out, stride=1, padding=1, via_patches=via_patches)
     out, bn2_s = layers.batch_norm(
         params["bn2"], state["bn2"], out, use_batch_stats, update_running,
-        sample_weight=sample_weight,
+        sample_weight=sample_weight, stat_dtype=stat_dtype,
     )
     new_state = {"bn1": bn1_s, "bn2": bn2_s}
     if "downsample" in params:
@@ -69,6 +69,7 @@ def _apply_basic_block(
         identity, dbn_s = layers.batch_norm(
             params["downsample"]["bn"], state["downsample"]["bn"], identity,
             use_batch_stats, update_running, sample_weight=sample_weight,
+            stat_dtype=stat_dtype,
         )
         new_state["downsample"] = {"bn": dbn_s}
     return layers.relu(out + identity), new_state
@@ -109,7 +110,7 @@ def build_resnet(
         return params, state
 
     def apply(params, state, x, *, use_batch_stats=True, update_running=False,
-              sample_weight=None):
+              sample_weight=None, stat_dtype=None):
         new_state = {}
         for si, n in enumerate(blocks_per_stage):
             lname = f"layer{si + 1}"
@@ -120,7 +121,7 @@ def build_resnet(
                 x, bs = _apply_basic_block(
                     params[lname][bname], state[lname][bname], x, stride,
                     use_batch_stats, update_running, conv_via_patches,
-                    sample_weight,
+                    sample_weight, stat_dtype,
                 )
                 stage_s[bname] = bs
             new_state[lname] = stage_s

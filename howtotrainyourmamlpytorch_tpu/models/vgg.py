@@ -26,30 +26,49 @@ def build_vgg(
     norm_layer: str = "batch_norm",
     conv_via_patches: bool = False,
     reduce_window_pool: bool = False,
+    fuse_conv_bn: bool = False,
 ) -> Model:
     """``conv_via_patches`` / ``reduce_window_pool`` bake the conv
     implementation and pooling tie-subgradient convention into THIS model's
     apply (explicit parameters, not process globals — each model's traced
-    programs carry their own conventions; see layers.conv2d / layers.max_pool)."""
+    programs carry their own conventions; see layers.conv2d / layers.max_pool).
+    ``fuse_conv_bn`` (Config.precision.fuse_conv_bn) folds each stage's BN
+    scale/shift into the patches-GEMM epilogue (layers.conv2d_bn_patches) —
+    same math up to f.p. reassociation; requires ``conv_via_patches``."""
     if norm_layer != "batch_norm":
         raise ValueError("only batch_norm is supported (reference models.py:38-41)")
+    if fuse_conv_bn and not conv_via_patches:
+        raise ValueError(
+            "fuse_conv_bn is a patches-GEMM epilogue and requires "
+            "conv_via_patches=True (Config auto-enables it)"
+        )
     h, w, c = image_shape
     conv_stride = 1 if max_pooling else 2
     pad = 1 if conv_padding else 0
 
-    def stem(params, state, x, use_batch_stats, update_running, sample_weight=None):
+    def stem(params, state, x, use_batch_stats, update_running,
+             sample_weight=None, stat_dtype=None):
         new_state = {}
         for i in range(num_stages):
             name = f"stage_{i}"
             p = params[name]
-            x = layers.conv2d(
-                p["conv"], x, stride=conv_stride, padding=pad,
-                via_patches=conv_via_patches,
-            )
-            x, bn_state = layers.batch_norm(
-                p["bn"], state[name]["bn"], x, use_batch_stats, update_running,
-                sample_weight=sample_weight,
-            )
+            if fuse_conv_bn:
+                x, bn_state = layers.conv2d_bn_patches(
+                    p["conv"], p["bn"], state[name]["bn"], x,
+                    stride=conv_stride, padding=pad,
+                    use_batch_stats=use_batch_stats,
+                    update_running=update_running,
+                    sample_weight=sample_weight, stat_dtype=stat_dtype,
+                )
+            else:
+                x = layers.conv2d(
+                    p["conv"], x, stride=conv_stride, padding=pad,
+                    via_patches=conv_via_patches,
+                )
+                x, bn_state = layers.batch_norm(
+                    p["bn"], state[name]["bn"], x, use_batch_stats, update_running,
+                    sample_weight=sample_weight, stat_dtype=stat_dtype,
+                )
             new_state[name] = {"bn": bn_state}
             x = layers.leaky_relu(x)
             if max_pooling:
@@ -69,7 +88,7 @@ def build_vgg(
             state[f"stage_{i}"] = {"bn": bn_s}
             cin = cnn_num_filters
         feat_shape = jax.eval_shape(
-            lambda p, s: stem(p, s, jnp.zeros((1, h, w, c)), True, False, None)[0],
+            lambda p, s: stem(p, s, jnp.zeros((1, h, w, c)), True, False)[0],
             params,
             state,
         ).shape
@@ -78,9 +97,10 @@ def build_vgg(
         return params, state
 
     def apply(params, state, x, *, use_batch_stats=True, update_running=False,
-              sample_weight=None):
+              sample_weight=None, stat_dtype=None):
         x, new_state = stem(
-            params, state, x, use_batch_stats, update_running, sample_weight
+            params, state, x, use_batch_stats, update_running, sample_weight,
+            stat_dtype,
         )
         x = layers.flatten(x)
         return layers.linear(params["fc"], x), new_state
@@ -92,4 +112,5 @@ def build_vgg(
         conv_via_patches=conv_via_patches,
         # pooling convention only applies when the backbone actually pools
         reduce_window_pool=reduce_window_pool if max_pooling else None,
+        fuse_conv_bn=fuse_conv_bn,
     )

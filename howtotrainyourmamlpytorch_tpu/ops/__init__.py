@@ -1,2 +1,3 @@
-from . import inner_optim, losses, msl  # noqa: F401
+from . import inner_optim, losses, msl, precision  # noqa: F401
 from .inner_optim import InnerOptimizer, build_inner_optimizer  # noqa: F401
+from .precision import PrecisionPolicy, policy_from_config  # noqa: F401

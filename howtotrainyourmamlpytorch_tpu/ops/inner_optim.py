@@ -66,7 +66,13 @@ def sgd(lr: float = 0.1, fused: bool = False) -> InnerOptimizer:
             from .pallas_update import fused_sgd_update
 
             return fused_sgd_update(params, grads, hparams["lr"]), state
-        new_params = jax.tree.map(lambda p, g, a: p - a * g, params, grads, hparams["lr"])
+        # the lr stays an f32 master (LSLR meta-gradients accumulate in f32)
+        # and is cast to the fast-weight dtype AT USE — a no-op in f32, and
+        # under the bf16_inner policy it keeps `p - lr*g` (and the scan
+        # carry) in the compute dtype instead of silently promoting to f32
+        new_params = jax.tree.map(
+            lambda p, g, a: p - a.astype(p.dtype) * g, params, grads, hparams["lr"]
+        )
         return new_params, state
 
     def project_hparams(hparams):
@@ -98,6 +104,9 @@ def adam(lr: float = 0.1, beta1: float = 0.5, beta2: float = 0.5, eps: float = 1
 
     def update(grads, state, params, hparams):
         def leaf(p, g, m, v, t, a, b1, b2):
+            # f32 hparam masters cast to the fast-weight dtype at use (no-op
+            # in f32; keeps the bf16_inner update chain in the compute dtype)
+            a, b1, b2 = (h.astype(p.dtype) for h in (a, b1, b2))
             t = t + 1.0
             m = b1 * m + (1.0 - b1) * g
             v = b2 * v + (1.0 - b2) * g * g

@@ -3,6 +3,8 @@
 import jax
 import jax.numpy as jnp
 
+from .precision import as_f32
+
 
 def cross_entropy(logits, labels, sample_weight=None):
     """Mean softmax cross-entropy with integer labels (= F.cross_entropy,
@@ -20,4 +22,4 @@ def cross_entropy(logits, labels, sample_weight=None):
 
 
 def accuracy(logits, labels):
-    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+    return jnp.mean(as_f32(jnp.argmax(logits, axis=-1) == labels))

@@ -24,6 +24,16 @@ with the backward implemented as a second fused kernel; the per-row lr
 cotangents reduce back to per-tensor lr cotangents through the (differentiable)
 gather's transpose, i.e. a segment-sum handled by XLA outside the kernel.
 
+Mixed precision (ops/precision.py bf16_inner policy): the packed param/grad
+buffers keep whatever dtype the fast weights arrive in — bf16 operands stream
+through VMEM at half the bytes, no upcast round-trip — while the lr column is
+pinned to f32 (the LSLR lrs are f32 masters) and both kernels accumulate in
+the lr's dtype: the forward computes ``p - lr*g`` in f32 and rounds once to
+the operand dtype on store; the backward reduces the per-row lr cotangent
+``-sum_row(ct * g)`` in f32, where a bf16 row-sum would lose exactly the
+small-residual signal LSLR meta-learns from. With f32 operands everything
+below is bit-identical to the pre-mixed-precision kernels.
+
 Off-TPU (the CPU test mesh) the same kernels run in Pallas interpret mode, so
 the suite exercises the identical code path everywhere.
 """
@@ -35,6 +45,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+
+from .precision import as_f32
 
 try:  # pltpu imports fail on builds without the TPU extension
     from jax.experimental.pallas import tpu as pltpu
@@ -111,13 +123,21 @@ def unpack(buf: jnp.ndarray, layout: PackedLayout):
 
 
 def _fwd_kernel(p_ref, g_ref, lr_ref, out_ref):
-    out_ref[:] = p_ref[:] - lr_ref[:] * g_ref[:]
+    # accumulate in the lr's dtype (f32): bf16 operands upcast in-kernel,
+    # one rounding on store; pure f32 traffic is untouched (astype no-ops)
+    acc = lr_ref.dtype
+    out_ref[:] = (p_ref[:].astype(acc) - lr_ref[:] * g_ref[:].astype(acc)).astype(
+        out_ref.dtype
+    )
 
 
 def _bwd_kernel(ct_ref, g_ref, lr_ref, dg_ref, dlr_ref):
-    ct = ct_ref[:]
-    dg_ref[:] = -lr_ref[:] * ct
-    dlr_ref[:] = -jnp.sum(ct * g_ref[:], axis=1, keepdims=True)
+    acc = lr_ref.dtype
+    ct = ct_ref[:].astype(acc)
+    dg_ref[:] = (-lr_ref[:] * ct).astype(dg_ref.dtype)
+    # the per-row lr cotangent is a 128-wide reduction of tiny products —
+    # kept in f32 so the LSLR meta-gradient doesn't drown in bf16 rounding
+    dlr_ref[:] = -jnp.sum(ct * g_ref[:].astype(acc), axis=1, keepdims=True)
 
 
 def _row_specs(n: int):
@@ -189,7 +209,9 @@ def fused_sgd_update(params, grads, lr_tree, layout: PackedLayout = None):
     g_buf = pack(grads, layout)
     lr_vec = jnp.stack([jnp.asarray(x).reshape(()) for x in jax.tree.leaves(lr_tree)])
     # static gather: per-row lr; its VJP (segment scatter-add) routes the
-    # per-row lr cotangents from the kernel back to the per-tensor lrs.
-    lr_rows = lr_vec[jnp.asarray(layout.row_map)][:, None].astype(p_buf.dtype)
+    # per-row lr cotangents from the kernel back to the per-tensor lrs. The
+    # column is pinned to f32 — it is the kernels' accumulation dtype, and
+    # the lrs are f32 masters even when p/g stream through as bf16.
+    lr_rows = as_f32(lr_vec[jnp.asarray(layout.row_map)][:, None])
     out = _fused_sgd(p_buf, g_buf, lr_rows)
     return unpack(out, layout)

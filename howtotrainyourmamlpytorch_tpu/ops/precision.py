@@ -1,0 +1,143 @@
+"""Mixed-precision policy: THE home of float-dtype cast boundaries on the
+hot path (ROADMAP item 3 — push MFU from 8% toward the hardware).
+
+The meta-step's inner rollout is a long chain of small convs and tiny
+per-tensor updates — exactly the regime where a bf16 MXU path pays off. But
+MAML++'s meta-gradient is a small residual of large terms: the *accumulation*
+points (BN batch statistics, loss/log-softmax reductions, the MSL-weighted
+outer loss, the outer Adam state) must stay f32 or the second-order signal
+drowns in rounding (the 20-way collapse family, scripts/grad_precision_probe.py).
+The :class:`PrecisionPolicy` encodes that split once:
+
+- **f32** (the default, ``Config.precision.enabled=false`` +
+  ``compute_dtype="float32"``): every cast helper is the identity on f32
+  inputs — the traced programs are bit-identical to a build without this
+  module.
+- **legacy_bf16** (``compute_dtype="bfloat16"`` with the precision block
+  off): the pre-ISSUE-9 behavior, preserved exactly — params and inputs cast
+  to bf16 per forward, BN statistics in the compute dtype, fast-weight math
+  in f32 (the inner grads are taken w.r.t. the f32 masters).
+- **bf16_inner** (``Config.precision.enabled=true``): the principled policy.
+  Params and LSLR lrs stay f32 *master* copies in the ``TrainState``; the
+  fast weights (and the differentiable inner-optimizer state) are cast to
+  bf16 ONCE at rollout entry, so the whole inner forward/backward/update
+  chain runs in bf16 — half the HBM traffic, single-pass MXU — while BN
+  statistics and every loss reduction run in ``stat_dtype`` (f32) and the
+  meta-gradient accumulates in f32 through the (differentiable) entry cast.
+
+Every float-dtype cast on the hot path lives here or is parameterized from
+here (``stat_dtype`` threaded into ``models/layers.py::batch_norm``, the lr
+column of ``ops/pallas_update.py``); graftlint rule GL140 pins the hot-path
+modules to exactly that — a literal ``.astype(jnp.float32)`` anywhere else in
+``models/ core/ ops/ serving/`` is a finding.
+"""
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def as_f32(x):
+    """The sanctioned f32 upcast for metric/reduction math (accuracy masks,
+    loss reductions, the Pallas lr column). Hot-path modules call this
+    instead of spelling ``.astype(jnp.float32)`` so GL140 can pin every
+    other float cast to this module."""
+    return jnp.asarray(x).astype(jnp.float32)
+
+
+def cast_tree(tree, dtype):
+    """Cast every float leaf of a pytree to ``dtype``; integer/bool leaves
+    (labels, step counters) pass through untouched. Differentiable: the
+    cast's transpose casts cotangents back, so meta-gradients w.r.t. the f32
+    masters accumulate in f32."""
+
+    def leaf(a):
+        a = jnp.asarray(a)
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            return a.astype(dtype)
+        return a
+
+    return jax.tree.map(leaf, tree)
+
+
+class PrecisionPolicy(NamedTuple):
+    """Static cast-boundary description threaded through ``MAMLSystem``,
+    the model applies, and the serving engine (train and serve share the one
+    policy the system was built with)."""
+
+    name: str
+    # dtype the model forward (and, under ``cast_inner``, the whole inner
+    # loop) runs in
+    compute_dtype: Any = jnp.float32
+    # dtype BN batch statistics are reduced in; None = the input dtype
+    # (the f32 and legacy paths — no extra casts in the traced program)
+    stat_dtype: Optional[Any] = None
+    # cast fast weights + inner-optimizer state ONCE at rollout entry (the
+    # bf16_inner policy); False = the masters' dtype flows through the loop
+    cast_inner: bool = False
+
+    # ------------------------------------------------------------------
+
+    def cast_forward_inputs(self, params, x):
+        """Entry cast of one model forward: params + input batch to the
+        compute dtype. Identity (no ops traced) when compute is f32 — and a
+        no-op re-cast when the fast weights already arrive in the compute
+        dtype (the bf16_inner rollout)."""
+        cdt = self.compute_dtype
+        if cdt != jnp.float32:
+            params = cast_tree(params, cdt)
+            x = x.astype(cdt)
+        return params, x
+
+    def cast_logits(self, logits):
+        """Exit cast: logits to f32 so the loss/log-softmax reduction always
+        runs in full precision, whatever the forward ran in."""
+        return as_f32(logits)
+
+    def cast_fast_weights(self, tree):
+        """Rollout-entry cast of the fast-weight pytree (and the
+        differentiable inner-optimizer state): bf16 under the bf16_inner
+        policy, identity otherwise. The f32 master copies in the TrainState
+        are never touched — this cast is a node in the meta-gradient graph."""
+        if not self.cast_inner:
+            return tree
+        return cast_tree(tree, self.compute_dtype)
+
+    def describe(self) -> Dict[str, Any]:
+        """JSON-able summary for bench lines / serving metrics."""
+        return {
+            "name": self.name,
+            "compute_dtype": jnp.dtype(self.compute_dtype).name,
+            "stat_dtype": (
+                None if self.stat_dtype is None else jnp.dtype(self.stat_dtype).name
+            ),
+            "cast_inner": self.cast_inner,
+        }
+
+
+F32 = PrecisionPolicy(name="f32")
+
+
+def policy_from_config(cfg) -> PrecisionPolicy:
+    """Resolve the one policy a system (train or serve) runs under.
+
+    ``Config.precision.enabled`` selects the principled bf16_inner policy;
+    with the block off, the legacy ``compute_dtype`` knob keeps its exact
+    pre-policy semantics (per-forward operand cast, statistics in the
+    compute dtype) so existing configs and the flagship bench recipe are
+    bit-identical to before this module existed."""
+    pc = getattr(cfg, "precision", None)
+    if pc is not None and pc.enabled:
+        if pc.compute_dtype == "float32":
+            # an explicitly-enabled f32 policy degenerates to the plain path
+            return F32
+        return PrecisionPolicy(
+            name="bf16_inner",
+            compute_dtype=jnp.bfloat16,
+            stat_dtype=jnp.float32 if pc.stat_dtype == "float32" else None,
+            cast_inner=True,
+        )
+    if cfg.compute_dtype == "bfloat16":
+        return PrecisionPolicy(name="legacy_bf16", compute_dtype=jnp.bfloat16)
+    return F32

@@ -282,6 +282,10 @@ class AdaptationEngine:
             out: Dict[str, Any] = {
                 "adapt_programs": len(self._adapt_jit),
                 "predict_programs": len(self._predict_jit),
+                # the ONE policy train and serve share (ops/precision.py):
+                # the engine's adapt/predict programs run under the same
+                # cast boundaries the system trained with
+                "precision": self.system.precision.name,
             }
         if self.recompile_guard is not None:
             out["recompile_guard"] = self.recompile_guard.snapshot()

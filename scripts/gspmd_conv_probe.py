@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Probe: does THIS jaxlib still hard-crash GSPMD on dp-sharded native convs?
+
+PR 1 found that compiling the dp-sharded, vmapped-per-task-kernel program
+family with *native* ``lax.conv_general_dilated`` dies in a
+``convolution_handler.cc`` CHECK failure — a silent SIGABRT, not a Python
+exception — on jaxlib 0.4.37. That is why ``Config.conv_via_patches`` (the
+patches-GEMM detour) exists and why ``parallel.tp_convs`` requires it. The
+detour costs layout/padding FLOPs, so it should be retired the moment a
+jaxlib upgrade fixes the partitioner (ROADMAP item 3).
+
+This probe makes the re-test one command: it compiles the crashing program
+shape (per-task adapted conv kernels under ``vmap`` == batch-grouped
+convolution, meta-batch sharded over a dp mesh) in a SUBPROCESS — the only
+way to survive a CHECK-failure abort — and prints ONE JSON verdict line::
+
+    python scripts/gspmd_conv_probe.py
+    -> {"probe": "gspmd_native_conv", "verdict": "crash", "child_rc": -6, ...}
+
+- ``verdict: "ok"``      -> the partitioner handles it: retire the detour
+                            (flip the dp>1 defaults back to native convs,
+                            re-measure BENCH_CONV_VIA_PATCHES=0 vs 1).
+- ``verdict: "crash"``   -> keep ``conv_via_patches`` for dp>1 programs.
+- ``verdict: "error"``   -> the child failed some other way (Python raise /
+                            no second device); stderr has the detail.
+
+Record the verdict + jaxlib in docs/OPERATIONS.md ("Mixed precision and the
+patches detour") whenever a new jaxlib lands. rc: 0 = probe ran (whatever
+the verdict), 2 = usage/setup failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_CHILD_OK = "GSPMD_PROBE_CHILD_OK"
+
+
+def child() -> int:
+    """Compile the crash-family program in-process (may SIGABRT — run me in
+    a subprocess). This is the REAL program, not a distillation: the tiny
+    MAMLSystem second-order train step with native convs and the meta-batch
+    sharded over a dp=2 mesh — the exact family PR 1's test configs died on.
+    (A hand-rolled vmap(conv)+grad distillation compiles fine on jaxlib
+    0.4.36, so anything weaker than the full meta-step is a false 'ok'.)"""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    devices = jax.devices()
+    if len(devices) < 2:
+        print("gspmd_conv_probe: need >= 2 devices for a dp mesh", file=sys.stderr)
+        return 3
+
+    import jax.numpy as jnp
+
+    from howtotrainyourmamlpytorch_tpu.config import Config, ParallelConfig
+    from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+    from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+    from howtotrainyourmamlpytorch_tpu.models import build_vgg
+    from howtotrainyourmamlpytorch_tpu.parallel import mesh as pmesh
+
+    cfg = Config(
+        num_classes_per_set=3, num_samples_per_class=2, num_target_samples=2,
+        batch_size=2, number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2, total_iter_per_epoch=4,
+        total_epochs=5, parallel=ParallelConfig(dp=2),
+        conv_via_patches=False,  # the whole point: probe the NATIVE conv
+    )
+    system = MAMLSystem(
+        cfg,
+        model=build_vgg((28, 28, 1), 3, num_stages=2, cnn_num_filters=4,
+                        conv_via_patches=False),
+    )
+    state = jax.device_put(system.init_train_state(), pmesh.replicated(
+        pmesh.make_mesh(cfg.parallel)
+    ))
+    mesh = pmesh.make_mesh(cfg.parallel)
+    batch = {
+        k: jnp.asarray(v)
+        for k, v in synthetic_batch(2, 3, 2, 2, (28, 28, 1), seed=0).items()
+    }
+    batch = pmesh.shard_batch(batch, mesh)
+    fn = system._compiled_train_step(True, True)
+    fn.lower(state, batch).compile()  # the crash site: GSPMD partitioning
+    print(_CHILD_OK, flush=True)
+    return 0
+
+
+def run_probe(timeout_s: float = 600.0) -> dict:
+    """Spawn the child and fold its fate into the verdict dict."""
+    env = dict(os.environ)
+    # the crash is platform-independent in the partitioner; default the
+    # probe onto local CPU devices so it runs anywhere (a chip session can
+    # export JAX_PLATFORMS/GSPMD_PROBE_DEVICES to probe the real backend)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        rc: int = proc.returncode
+        ok = rc == 0 and _CHILD_OK in proc.stdout
+        stderr_tail = proc.stderr[-2000:]
+        timed_out = False
+    except subprocess.TimeoutExpired:
+        rc, ok = -1, False
+        stderr_tail = f"child timed out after {timeout_s}s"
+        timed_out = True
+    return verdict_from_child(rc, ok, stderr_tail, timed_out=timed_out)
+
+
+def verdict_from_child(
+    rc: int, ok: bool, stderr_tail: str = "", timed_out: bool = False
+) -> dict:
+    """Map the child's exit to the one-line verdict contract (pure — the
+    tier-1 contract test drives this without paying a subprocess). A
+    timeout is an ``error``, never a ``crash``: a slow compile must not
+    write a false 'GSPMD still crashes' row into the OPERATIONS table."""
+    import jax
+    import jaxlib
+
+    if ok:
+        verdict, action = "ok", (
+            "partitioner fixed: retire the patches detour for dp>1 native "
+            "convs and re-measure BENCH_CONV_VIA_PATCHES=0"
+        )
+    elif timed_out:
+        verdict, action = "error", (
+            "child compile exceeded the probe timeout — no verdict; re-run "
+            "with a larger budget"
+        )
+    elif rc < 0 or rc in (134, 139):  # signal death: SIGABRT/SIGSEGV family
+        verdict, action = "crash", (
+            "keep Config.conv_via_patches for dp-sharded programs "
+            "(GSPMD convolution_handler CHECK failure still present)"
+        )
+    else:
+        verdict, action = "error", "child failed before the compile verdict"
+    return {
+        "probe": "gspmd_native_conv",
+        "verdict": verdict,
+        "child_rc": rc,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.version.__version__,
+        "action": action,
+        **({"stderr_tail": stderr_tail} if verdict == "error" else {}),
+    }
+
+
+def main(argv) -> int:
+    if "--child" in argv:
+        return child()
+    if any(a not in ("--child",) and a.startswith("-") for a in argv):
+        print(__doc__, file=sys.stderr)
+        return 2
+    report = run_probe()
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -276,6 +276,90 @@ def test_gl130_donation_after_use(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# GL140 — float-dtype cast outside the precision policy
+# ---------------------------------------------------------------------------
+
+
+def _lint_hot_path_snippet(tmp_path, source, rel="howtotrainyourmamlpytorch_tpu/models/fake_layer.py"):
+    """GL140 is path-scoped to the hot-path packages; fixtures must live
+    under a matching fragment to be in scope."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return run_lint([str(path)], ["GL140"])
+
+
+def test_gl140_literal_float_casts_are_findings(tmp_path):
+    active, _ = _lint_hot_path_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def fwd(x):
+            a = x.astype(jnp.float32)         # GL140
+            b = x.astype("bfloat16")          # GL140
+            c = x.astype(np.float64)          # GL140
+            d = x.astype(dtype=jnp.float32)   # GL140: keyword form too
+            return a, b, c, d
+        """,
+    )
+    assert _rules_of(active) == ["GL140"] * 4
+
+
+def test_gl140_value_derived_and_out_of_scope_casts_are_clean(tmp_path):
+    clean = """
+        import jax.numpy as jnp
+
+        def fwd(x, p, stat_dtype=None):
+            y = x.astype(p.dtype)          # dtype-relative: the policy idiom
+            z = x.astype(stat_dtype)       # threaded parameter
+            n = x.astype(jnp.int32)        # not a float dtype
+            return y, z, n
+        """
+    active, _ = _lint_hot_path_snippet(tmp_path, clean)
+    assert active == []
+    # ops/precision.py is the policy HOME: literal casts are its job
+    active, _ = _lint_hot_path_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def as_f32(x):
+            return x.astype(jnp.float32)
+        """,
+        rel="howtotrainyourmamlpytorch_tpu/ops/precision.py",
+    )
+    assert active == []
+    # a module outside the hot-path packages is out of scope entirely
+    active, _ = _lint_hot_path_snippet(
+        tmp_path,
+        """
+        import numpy as np
+
+        def load(x):
+            return x.astype(np.float32)
+        """,
+        rel="howtotrainyourmamlpytorch_tpu/data/fake_loader.py",
+    )
+    assert active == []
+
+
+def test_gl140_suppression_with_justification(tmp_path):
+    active, suppressed = _lint_hot_path_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def fwd(x):
+            # host-side metric table, not the compiled hot path  # graftlint: disable=GL140
+            return x.astype(jnp.float32)
+        """,
+    )
+    assert active == [] and _rules_of(suppressed) == ["GL140"]
+
+
+# ---------------------------------------------------------------------------
 # GL201 / GL202 — concurrency
 # ---------------------------------------------------------------------------
 
@@ -512,7 +596,7 @@ def test_json_schema_and_counts(tmp_path):
 def test_rule_catalog_is_complete():
     expected = {
         "GL101", "GL102", "GL110", "GL120", "GL121", "GL122", "GL130",
-        "GL201", "GL202", "GL301", "GL302", "GL303",
+        "GL140", "GL201", "GL202", "GL301", "GL302", "GL303",
     }
     assert expected <= set(RULES)
     for rule_id in expected:
@@ -669,6 +753,31 @@ def test_self_gate_covers_aot_paths_explicitly():
     finally:
         os.chdir(cwd)
     assert active == [], "unsuppressed findings in AOT paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
+def test_self_gate_covers_precision_paths_explicitly():
+    """The mixed-precision layer (ISSUE 9) sits inside the self-gate on its
+    own terms: ops/precision.py is the one module allowed literal float
+    casts, and the hot-path modules it governs (layers, the meta-step, the
+    inner optimizers, the serving engine) must be GL140-clean — zero
+    unsuppressed findings even if the top-level path list is restructured."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join("howtotrainyourmamlpytorch_tpu", "ops"),
+                os.path.join("howtotrainyourmamlpytorch_tpu", "models"),
+                os.path.join("howtotrainyourmamlpytorch_tpu", "core"),
+                os.path.join("howtotrainyourmamlpytorch_tpu", "serving"),
+                os.path.join("scripts", "gspmd_conv_probe.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in precision paths:\n" + "\n".join(
         f.format() for f in active
     )
 

@@ -120,3 +120,107 @@ def test_full_meta_step_parity_fused_vs_plain():
         (state_f.params, state_f.inner_hparams),
         (state_p.params, state_p.inner_hparams),
     )
+
+
+# ---------------------------------------------------------------------------
+# bf16-operand variant (ISSUE 9): bf16 p/g buffers, f32 lr column, f32
+# accumulation in the backward — no upcast round-trip for the packed update
+# ---------------------------------------------------------------------------
+
+
+def _bf16_tree(seed):
+    return jax.tree.map(lambda a: a.astype(jnp.bfloat16), _tree(seed))
+
+
+def test_fused_bf16_operands_match_f32_accumulated_reference():
+    """Forward: bf16 operands, f32 accumulate, ONE rounding on store — the
+    kernel must equal the f32-computed update rounded once to bf16."""
+    params, grads = _bf16_tree(0), _bf16_tree(1)
+    lrs = _lrs(params)
+    fused = fused_sgd_update(params, grads, lrs)
+    ref = jax.tree.map(
+        lambda p, g, a: (
+            p.astype(jnp.float32) - a * g.astype(jnp.float32)
+        ).astype(jnp.bfloat16),
+        params,
+        grads,
+        lrs,
+    )
+    for got, want in zip(jax.tree.leaves(fused), jax.tree.leaves(ref)):
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(got, np.float32), np.asarray(want, np.float32)
+        )
+
+
+def test_fused_bf16_gradients_f32_lr_cotangent():
+    """Backward: dp/dg come back in the operand dtype while the per-tensor
+    lr cotangent is accumulated (and returned) in f32 — matching the plain
+    mixed-dtype autodiff path."""
+    params, grads = _bf16_tree(0), _bf16_tree(1)
+    lrs = _lrs(params)
+    target = _bf16_tree(2)
+
+    def objective(update_fn, p, g, a):
+        new = update_fn(p, g, a)
+        return sum(
+            jnp.sum((x.astype(jnp.float32) - t.astype(jnp.float32)) ** 2)
+            for x, t in zip(jax.tree.leaves(new), jax.tree.leaves(target))
+        )
+
+    plain_fn = lambda p, g, a: jax.tree.map(
+        lambda x, y, z: (
+            x.astype(jnp.float32) - z * y.astype(jnp.float32)
+        ).astype(jnp.bfloat16),
+        p, g, a,
+    )
+    g_fused = jax.grad(
+        lambda *args: objective(fused_sgd_update, *args), argnums=(0, 1, 2)
+    )(params, grads, lrs)
+    g_plain = jax.grad(
+        lambda *args: objective(plain_fn, *args), argnums=(0, 1, 2)
+    )(params, grads, lrs)
+    for leaf in jax.tree.leaves(g_fused[0]) + jax.tree.leaves(g_fused[1]):
+        assert leaf.dtype == jnp.bfloat16
+    for leaf in jax.tree.leaves(g_fused[2]):
+        assert leaf.dtype == jnp.float32
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-2, atol=1e-3,
+        ),
+        g_fused,
+        g_plain,
+    )
+
+
+def test_full_meta_step_parity_fused_vs_plain_bf16_inner():
+    """The flagship mixed-precision check: under the bf16_inner policy one
+    full train step (MSL on, learnable lrs) through the Pallas kernel
+    matches the plain bf16 path — losses equal to bf16 tolerance, updated
+    f32 masters and learned lrs close."""
+    from howtotrainyourmamlpytorch_tpu.config import PrecisionConfig
+
+    results = {}
+    for fused in (False, True):
+        cfg = tiny_config(
+            use_pallas_inner_update=fused,
+            precision=PrecisionConfig(enabled=True),
+        )
+        system = MAMLSystem(cfg, model=tiny_linear_model())
+        state = system.init_train_state()
+        batch = _as_jnp(tiny_batch())
+        state, out = system.train_step(state, batch, epoch=0)
+        results[fused] = (float(out.loss), state)
+    loss_p, state_p = results[False]
+    loss_f, state_f = results[True]
+    np.testing.assert_allclose(loss_f, loss_p, rtol=2e-2)
+    for a in jax.tree.leaves(state_f.params):
+        assert a.dtype == jnp.float32  # masters stay f32 through the kernel
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-2, atol=5e-3
+        ),
+        (state_f.params, state_f.inner_hparams),
+        (state_p.params, state_p.inner_hparams),
+    )

@@ -8,6 +8,8 @@ GL121  unseeded module-level RNG in library code
 GL122  set-iteration ordering feeding construction
 GL130  donation-after-use (reading an argument passed through a
        ``donate_argnums`` position)
+GL140  float-dtype cast outside the precision policy (hot-path modules
+       must route casts through ``ops/precision.py``)
 
 GL101/GL102 run a module-local taint analysis: parameters of functions
 passed to ``jit``/``pjit``/``shard_map`` (and of functions those call, via
@@ -802,4 +804,86 @@ class DonationAfterUse(Rule):
                         )
                     )
                     donated.pop(name, None)
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# GL140 — float-dtype cast outside the precision policy
+# ---------------------------------------------------------------------------
+
+# Modules whose float-cast discipline belongs to ops/precision.py: the
+# compiled hot path (layer math, the meta-step, the inner optimizers, the
+# serving dispatch). Matched by path fragment so the rule follows the files,
+# not a marker someone has to remember.
+PRECISION_SCOPED_FRAGMENTS = (
+    "howtotrainyourmamlpytorch_tpu/models/",
+    "howtotrainyourmamlpytorch_tpu/core/",
+    "howtotrainyourmamlpytorch_tpu/ops/",
+    "howtotrainyourmamlpytorch_tpu/serving/",
+)
+PRECISION_HOME_SUFFIX = "howtotrainyourmamlpytorch_tpu/ops/precision.py"
+FLOAT_DTYPE_NAMES = {
+    "float32", "float64", "float16", "bfloat16", "half", "single", "double",
+}
+
+
+@register
+class FloatCastOutsidePolicy(Rule):
+    id = "GL140"
+    title = "float-dtype cast outside the precision policy"
+
+    def _float_literal(self, module: Module, node: ast.AST):
+        """The dtype name when ``node`` is a literal float dtype — a string
+        constant ('float32') or a numpy/jnp attribute (jnp.bfloat16) —
+        None for anything value-derived (``p.dtype``, a ``stat_dtype``
+        parameter), which is exactly the dtype-relative discipline the
+        policy threads through and is always clean."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if node.value in FLOAT_DTYPE_NAMES else None
+        name = dotted_name(node)
+        if not name or "." not in name:
+            return None
+        head, _, attr = name.rpartition(".")
+        if attr not in FLOAT_DTYPE_NAMES:
+            return None
+        root = module.resolve_root(head.split(".")[0])
+        if root.split(".")[0] in ("numpy", "jax", "jnp", "np", "ml_dtypes"):
+            return name
+        return None
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        rel = module.rel.replace("\\", "/")
+        if rel.endswith(PRECISION_HOME_SUFFIX):
+            return ()
+        if not any(frag in rel for frag in PRECISION_SCOPED_FRAGMENTS):
+            return ()
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+            ):
+                continue
+            dtype_arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "dtype"), None
+            )
+            if dtype_arg is None:
+                continue
+            literal = self._float_literal(module, dtype_arg)
+            if literal is None:
+                continue
+            findings.append(
+                Finding(
+                    self.id,
+                    module.rel,
+                    node.lineno,
+                    node.col_offset,
+                    f".astype({literal}) in a hot-path module — float-dtype "
+                    "cast boundaries live in ops/precision.py (use the "
+                    "policy / as_f32, or a value-derived dtype like "
+                    "`p.dtype`); suppress with a justification if this cast "
+                    "really is not on the compiled hot path",
+                )
+            )
         return findings
