@@ -170,6 +170,15 @@ class ObservabilityConfig:
     # headroom fraction below which a one-shot (per device) hbm_headroom_low
     # event lands in events.jsonl — the pre-OOM breadcrumb
     hbm_headroom_warn_frac: float = 0.05
+    # request-scoped serving observability (observability/context.py): the
+    # structured access log, one JSON line per request in logs/access.jsonl
+    # (trace id, verb, bucket, flush batch, queue-wait/dispatch/total ms,
+    # cache hit, outcome, breaker state)
+    access_log: bool = True
+    # fraction of OK requests logged — deterministic on the trace id, so
+    # every process of a fleet keeps or drops the same request. Non-ok
+    # outcomes are ALWAYS logged regardless (the chaos invariant).
+    access_log_sample: float = 1.0
 
     def __post_init__(self):
         if self.histogram_window < 1:
@@ -191,6 +200,11 @@ class ObservabilityConfig:
             raise ValueError(
                 f"observability.hbm_headroom_warn_frac must be in [0, 1), "
                 f"got {self.hbm_headroom_warn_frac}"
+            )
+        if not 0.0 <= self.access_log_sample <= 1.0:
+            raise ValueError(
+                f"observability.access_log_sample must be in [0, 1], "
+                f"got {self.access_log_sample}"
             )
 
 

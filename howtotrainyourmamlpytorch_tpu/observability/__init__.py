@@ -29,13 +29,30 @@ The performance layer (ISSUE 7) builds on those three:
 - :mod:`slo` — deterministic open-loop load schedules + the SLO report
   (CLI: ``scripts/loadgen.py``).
 
+And the request-scoped layer (ISSUE 10):
+
+- :mod:`context` — ``RequestContext`` (W3C ``traceparent`` ids, minted when
+  absent) threaded HTTP thread -> batcher queue -> worker flush -> engine
+  dispatch, exported as Chrome flow events so one request renders as one
+  linked arc; plus the sampled structured access log
+  (``logs/access.jsonl``). Cross-process merge: ``scripts/trace_merge.py``;
+  live console: ``scripts/obs_top.py``.
+
 Knobs: ``Config.observability`` (``config.py::ObservabilityConfig``) —
 fully inert and bit-identical when disabled. Report CLI:
-``scripts/obs_report.py``; howto: ``docs/OPERATIONS.md`` "Reading a run"
-and "Performance triage".
+``scripts/obs_report.py``; howto: ``docs/OPERATIONS.md`` "Reading a run",
+"Performance triage", and "Tracing a request".
 """
 
 from .compile_ledger import CompileLedger  # noqa: F401
+from .context import (  # noqa: F401
+    AccessLog,
+    RequestContext,
+    format_traceparent,
+    new_request_context,
+    parse_traceparent,
+    read_access_log,
+)
 from .costs import jit_cost, mfu, peak_flops_per_sec, program_cost  # noqa: F401
 from .memory import MemoryWatermarks, device_memory_stats  # noqa: F401
 from .metrics import MetricsRegistry  # noqa: F401

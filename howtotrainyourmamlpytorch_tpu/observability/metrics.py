@@ -15,6 +15,7 @@ under the lock and releases it before numpy runs, so recorder threads never
 block behind ``/metrics`` percentile crunching.
 """
 
+import re
 import threading
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -131,6 +132,17 @@ class MetricsRegistry:
             }
         return out
 
+    def histogram_windows(self) -> List[Tuple[str, List[float], int, float]]:
+        """``(name, window copy, cumulative count, cumulative sum)`` per
+        histogram, copied under the lock — the raw-value surface for
+        renderers (``prometheus_text``) that need full precision rather
+        than ``summaries()``'s rounded ms table."""
+        with self._lock:
+            return [
+                (name, list(h.values), h.count, h.total)
+                for name, h in sorted(self._hists.items())
+            ]
+
     def snapshot(self) -> Dict[str, Any]:
         """Whole-registry snapshot: counters + gauges verbatim, histograms as
         ms-scaled percentile summaries."""
@@ -142,6 +154,53 @@ class MetricsRegistry:
             "gauges": gauges,
             "histograms": self.summaries(),
         }
+
+
+#: Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]*; everything else
+#: (the registry's dotted names) collapses to underscores
+_PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+#: summary quantiles exposed per histogram (matches summaries()'s p50/95/99)
+_PROM_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _prom_name(namespace: str, name: str) -> str:
+    sanitized = _PROM_BAD_CHARS.sub("_", name)
+    if sanitized and sanitized[0].isdigit():
+        sanitized = "_" + sanitized
+    return f"{namespace}_{sanitized}" if namespace else sanitized
+
+
+def prometheus_text(registry: "MetricsRegistry", namespace: str = "htymp") -> str:
+    """Prometheus/OpenMetrics text exposition of the registry — counters
+    (``_total``), numeric gauges, and histograms as summaries (quantile
+    series in base-unit SECONDS, plus ``_count``/``_sum``), each with a
+    ``# TYPE`` line. Serves ``/metrics?format=prom`` alongside the JSON
+    form; the key set is schema-pinned by test. Non-numeric gauges (state
+    strings, nested snapshots) are JSON-only — Prometheus samples are
+    float64, full stop."""
+    lines: List[str] = []
+    for name, value in sorted(registry.counters().items()):
+        metric = _prom_name(namespace, name) + "_total"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {value}")
+    for name, value in sorted(registry.gauges().items()):
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        metric = _prom_name(namespace, name)
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {value}")
+    # windows copied under the registry lock (inside histogram_windows),
+    # percentile math outside it — the same discipline as summaries()
+    for name, values, count, total in registry.histogram_windows():
+        metric = _prom_name(namespace, name)
+        lines.append(f"# TYPE {metric} summary")
+        if values:
+            arr = np.asarray(values, np.float64)
+            for q, v in zip(_PROM_QUANTILES, np.percentile(arr, [100 * q for q in _PROM_QUANTILES])):
+                lines.append(f'{metric}{{quantile="{q}"}} {round(float(v), 9)}')
+        lines.append(f"{metric}_count {count}")
+        lines.append(f"{metric}_sum {round(total, 9)}")
+    return "\n".join(lines) + "\n"
 
 
 class _Timer:

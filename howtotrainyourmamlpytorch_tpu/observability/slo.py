@@ -26,11 +26,18 @@ else). CLI: ``scripts/loadgen.py``.
 
 import concurrent.futures
 import dataclasses
+import inspect
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
+
+from .context import new_request_context, read_access_log
+
+#: how many worst request ids a failing stair names in the SLO report —
+#: enough to grep their flow traces, small enough to stay one JSON line
+DEFAULT_WORST_K = 5
 
 #: heavy-tail shape for inter-arrivals: lognormal sigma. 1.0 gives a burst
 #: profile where ~10% of gaps are >2.5x the mean — enough to exercise the
@@ -123,7 +130,14 @@ class _Results:
         self._lock = threading.Lock()
         self._rows: List[Dict[str, Any]] = []
 
-    def add(self, stair: int, kind: str, outcome: str, latency_ms: float) -> None:
+    def add(
+        self,
+        stair: int,
+        kind: str,
+        outcome: str,
+        latency_ms: float,
+        trace_id: Optional[str] = None,
+    ) -> None:
         with self._lock:
             self._rows.append(
                 {
@@ -131,6 +145,7 @@ class _Results:
                     "kind": kind,
                     "outcome": outcome,
                     "latency_ms": latency_ms,
+                    "trace_id": trace_id,
                 }
             )
 
@@ -219,18 +234,40 @@ def run_load(
     from ..resilience.retry import DeadlineExceededError
     from ..serving.server import ServiceUnavailableError
 
+    # loadgen-minted trace ids: every scheduled request carries its own
+    # RequestContext through the frontend, so a failing stair's worst
+    # request ids (slo_report) resolve to access-log lines and flow-linked
+    # span chains in the exported trace. Doubles without the ctx parameter
+    # (older/fake frontends) are driven exactly as before.
+    def _takes_ctx(fn) -> bool:
+        try:
+            return "ctx" in inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            return False
+
+    adapt_takes_ctx = _takes_ctx(frontend.adapt)
+    predict_takes_ctx = _takes_ctx(frontend.predict)
+
     def one(req: Request, sched_t: float) -> None:
+        ctx = new_request_context()
         try:
             if req.kind == "adapt":
                 x_s, y_s = make_support(req.episode_seed)
-                info = frontend.adapt(x_s, y_s)
+                if adapt_takes_ctx:
+                    info = frontend.adapt(x_s, y_s, ctx=ctx)
+                else:
+                    info = frontend.adapt(x_s, y_s)
                 with ids_lock:
                     ids.append(info["adaptation_id"])
                 outcome = "ok"
             else:
                 with ids_lock:
                     aid = ids[req.episode_seed % len(ids)]
-                frontend.predict(aid, make_query(req.episode_seed, req.n_query))
+                query = make_query(req.episode_seed, req.n_query)
+                if predict_takes_ctx:
+                    frontend.predict(aid, query, ctx=ctx)
+                else:
+                    frontend.predict(aid, query)
                 outcome = "ok"
         except ServiceUnavailableError:
             outcome = "shed"
@@ -239,7 +276,13 @@ def run_load(
         except Exception as exc:  # noqa: BLE001 — the report carries the count
             log(f"loadgen: request error: {type(exc).__name__}: {exc}")
             outcome = "error"
-        results.add(req.stair, req.kind, outcome, round((clock() - sched_t) * 1e3, 3))
+        results.add(
+            req.stair,
+            req.kind,
+            outcome,
+            round((clock() - sched_t) * 1e3, 3),
+            trace_id=ctx.trace_id,
+        )
 
     # -- open loop: launch at schedule time, never wait for completions --
     pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_workers)
@@ -290,6 +333,48 @@ def _percentiles(latencies: List[float]) -> Dict[str, Optional[float]]:
     return {"p50_ms": round(float(p50), 3), "p99_ms": round(float(p99), 3)}
 
 
+def _worst_requests(
+    mine: List[Dict[str, Any]],
+    worst_k: int,
+    access_index: Dict[str, Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """The K worst requests of a stair (by measured latency — deadline
+    misses carry deadline+queue, exactly the tail under investigation),
+    each joined with its access-log line's per-hop breakdown when one
+    landed. A bad p99 becomes one ``grep <trace_id>`` from its flow trace."""
+    ranked = sorted(mine, key=lambda r: r["latency_ms"], reverse=True)[:worst_k]
+    out = []
+    for r in ranked:
+        entry = {
+            "trace_id": r.get("trace_id"),
+            "kind": r["kind"],
+            "outcome": r["outcome"],
+            "latency_ms": r["latency_ms"],
+        }
+        access = access_index.get(r.get("trace_id"))
+        if access is not None:
+            entry.update(
+                {
+                    k: access.get(k)
+                    for k in ("queue_wait_ms", "dispatch_ms", "flush_batch", "bucket")
+                }
+            )
+        out.append(entry)
+    return out
+
+
+def _load_access_index(path: Optional[str]) -> Dict[str, Dict[str, Any]]:
+    if not path:
+        return {}
+    try:
+        records, _ = read_access_log(path)
+    except OSError:
+        return {}
+    # last line per id wins (adapt_predict logs two hops; the later hop is
+    # the one whose timing closed the request)
+    return {r["trace_id"]: r for r in records if r.get("trace_id")}
+
+
 def slo_report(
     schedule: List[Request],
     run: Dict[str, Any],
@@ -300,14 +385,20 @@ def slo_report(
     max_shed_rate: float,
     metric_suffix: str = "",
     platform: Optional[str] = None,
+    worst_k: int = DEFAULT_WORST_K,
+    access_log_path: Optional[str] = None,
     **extra: Any,
 ) -> Dict[str, Any]:
     """Aggregate raw outcomes into the one-JSON-line SLO report (BENCH-line
     contract: ``metric``/``value``/``unit``/``vs_baseline`` + diagnostics).
     Headline value = the highest offered load (req/s) whose stair met the
     SLO (p99 <= ``slo_p99_ms`` on completed requests AND shed+error rate <=
-    ``max_shed_rate``); None when no stair qualified."""
+    ``max_shed_rate``); None when no stair qualified. Every FAILING stair
+    names its ``worst_k`` worst request ids (joined with the access log at
+    ``access_log_path`` when given) so a bad p99 is one grep from its
+    per-request flow trace."""
     rows = run["rows"]
+    access_index = _load_access_index(access_log_path)
     unresolved_by_stair = run.get("unresolved_by_stair") or {}
     per_stair_s = float(duration_s) / len(stairs_rps)
     stairs: List[Dict[str, Any]] = []
@@ -338,18 +429,21 @@ def slo_report(
         )
         if met and (sustained is None or rps > sustained):
             sustained = float(rps)
-        stairs.append(
-            {
-                "offered_rps": float(rps),
-                "achieved_rps": round(counts["ok"] / per_stair_s, 3),
-                "n_offered": len(offered),
-                **counts,
-                "unresolved": unresolved,
-                "shed_rate": round(shed_rate, 4) if shed_rate is not None else None,
-                **pcts,
-                "slo_met": met,
-            }
-        )
+        stair_row = {
+            "offered_rps": float(rps),
+            "achieved_rps": round(counts["ok"] / per_stair_s, 3),
+            "n_offered": len(offered),
+            **counts,
+            "unresolved": unresolved,
+            "shed_rate": round(shed_rate, 4) if shed_rate is not None else None,
+            **pcts,
+            "slo_met": met,
+        }
+        if not met and mine and worst_k > 0:
+            stair_row["worst_requests"] = _worst_requests(
+                mine, worst_k, access_index
+            )
+        stairs.append(stair_row)
     totals = {
         k: sum(s[k] for s in stairs) for k in ("ok", "shed", "deadline", "error")
     }
@@ -375,5 +469,10 @@ def slo_report(
         "stairs": stairs,
         "wall_s": run["wall_s"],
     }
+    if access_log_path:
+        report["access_log"] = {
+            "path": access_log_path,
+            "lines": len(access_index),
+        }
     report.update(extra)
     return report

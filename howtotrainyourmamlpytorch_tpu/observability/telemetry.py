@@ -152,11 +152,13 @@ class TelemetryHub:
 
     # -- instrumentation hooks ----------------------------------------
 
-    def span(self, name: str, **tags):
-        """Trace-only span (no phase histogram) — fine-grained serving spans."""
+    def span(self, name: str, flows=None, **tags):
+        """Trace-only span (no phase histogram) — fine-grained serving
+        spans. ``flows`` links the span into a request's cross-thread arc
+        (observability/context.py flow helpers)."""
         if not self.enabled:
             return _NULL_PHASE
-        return self.tracer.span(name, **tags)
+        return self.tracer.span(name, flows=flows, **tags)
 
     def phase(self, name: str, **tags):
         """Span + ``phase.<name>`` histogram observation — the per-step unit."""

@@ -18,10 +18,11 @@ plus the schema, which the chaos campaign runs over every exported trace.
 """
 
 import json
+import os
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 
 class _NullSpan:
@@ -46,7 +47,7 @@ class NullTracer:
 
     enabled = False
 
-    def span(self, name: str, **tags):
+    def span(self, name: str, flows: Optional[Sequence] = None, **tags):
         return _NULL_SPAN
 
     def records(self) -> List[dict]:
@@ -72,14 +73,24 @@ class _Span:
     """Live span handed out by :meth:`SpanTracer.span`; records itself into
     the tracer's ring on exit. ``duration_s`` is set on exit so wrappers
     (the hub's phase histograms) reuse the span's own clock pair instead of
-    reading the clock again — one measurement, two consumers."""
+    reading the clock again — one measurement, two consumers. ``flows`` is
+    a sequence of ``(flow_id, role)`` pairs (role in ``s``/``t``/``f``)
+    exported as Chrome *flow events* anchored to this span, linking a
+    request's spans across threads (observability/context.py builds them)."""
 
-    __slots__ = ("_tracer", "name", "tags", "_t0", "_depth", "duration_s")
+    __slots__ = ("_tracer", "name", "tags", "flows", "_t0", "_depth", "duration_s")
 
-    def __init__(self, tracer: "SpanTracer", name: str, tags: Dict[str, Any]):
+    def __init__(
+        self,
+        tracer: "SpanTracer",
+        name: str,
+        tags: Dict[str, Any],
+        flows: Optional[Sequence] = None,
+    ):
         self._tracer = tracer
         self.name = name
         self.tags = tags
+        self.flows = tuple(flows) if flows else None
 
     def __enter__(self):
         self._depth = self._tracer._enter()
@@ -89,7 +100,9 @@ class _Span:
     def __exit__(self, *exc):
         t1 = self._tracer._clock()
         self.duration_s = t1 - self._t0
-        self._tracer._exit_record(self.name, self._t0, t1, self._depth, self.tags)
+        self._tracer._exit_record(
+            self.name, self._t0, t1, self._depth, self.tags, self.flows
+        )
         return False
 
 
@@ -99,18 +112,27 @@ class SpanTracer:
     ``capacity`` bounds the completed-span ring; evictions are counted in
     ``dropped`` so a truncated export is visible as truncated rather than
     passing for the whole run. ``clock`` must be monotonic; tests inject a
-    fake. Completed spans are ``(name, t0, t1, thread_name, depth, tags)``
-    tuples relative to the tracer's epoch (construction time).
+    fake. Completed spans are ``(name, t0, t1, thread_name, depth, tags,
+    flows)`` tuples relative to the tracer's epoch (construction time).
+    ``epoch_unix`` anchors that epoch to the wall clock so
+    ``scripts/trace_merge.py`` can align traces from different processes
+    on one timeline.
     """
 
     enabled = True
 
-    def __init__(self, capacity: int = 8192, clock: Callable[[], float] = time.monotonic):
+    def __init__(
+        self,
+        capacity: int = 8192,
+        clock: Callable[[], float] = time.monotonic,
+        wall_clock: Callable[[], float] = time.time,
+    ):
         if capacity < 1:
             raise ValueError(f"tracer capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self._clock = clock
         self._epoch = clock()
+        self.epoch_unix = wall_clock()
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=self.capacity)
         self._local = threading.local()
@@ -126,10 +148,10 @@ class SpanTracer:
             self._open += 1
         return depth
 
-    def _exit_record(self, name, t0, t1, depth, tags) -> None:
+    def _exit_record(self, name, t0, t1, depth, tags, flows=None) -> None:
         self._local.depth = depth
         rec = (name, t0 - self._epoch, t1 - self._epoch,
-               threading.current_thread().name, depth, tags)
+               threading.current_thread().name, depth, tags, flows)
         with self._lock:
             self._open -= 1
             if len(self._ring) == self.capacity:
@@ -138,9 +160,10 @@ class SpanTracer:
 
     # -- public API ----------------------------------------------------
 
-    def span(self, name: str, **tags) -> _Span:
-        """``with tracer.span("dispatch", epoch=3): ...``"""
-        return _Span(self, name, tags)
+    def span(self, name: str, flows: Optional[Sequence] = None, **tags) -> _Span:
+        """``with tracer.span("dispatch", epoch=3): ...``; ``flows`` links
+        this span into cross-thread request arcs (see :class:`_Span`)."""
+        return _Span(self, name, tags, flows=flows)
 
     def open_spans(self) -> int:
         """Spans entered but not yet exited, across all threads — zero when
@@ -154,36 +177,42 @@ class SpanTracer:
             ring = list(self._ring)
         return [
             {"name": n, "t0_s": t0, "t1_s": t1, "dur_s": t1 - t0,
-             "thread": thread, "depth": depth, "tags": tags}
-            for n, t0, t1, thread, depth, tags in ring
+             "thread": thread, "depth": depth, "tags": tags, "flows": flows}
+            for n, t0, t1, thread, depth, tags, flows in ring
         ]
 
     def durations_s(self, name: str) -> List[float]:
         with self._lock:
             ring = list(self._ring)
-        return [t1 - t0 for n, t0, t1, _, _, _ in ring if n == name]
+        return [t1 - t0 for n, t0, t1, *_ in ring if n == name]
 
     # -- export --------------------------------------------------------
 
     def to_chrome_trace(self) -> Dict[str, Any]:
         """Chrome trace-event JSON object. Only completed (balanced) spans
         are exported; in-flight spans and ring evictions are surfaced as
-        metadata so a partial trace reads as partial."""
+        metadata so a partial trace reads as partial. Spans recorded with
+        ``flows`` additionally emit flow events (``ph: s/t/f``, one shared
+        name+cat per the format's flow-binding rule, id = the trace id)
+        anchored at the span's start, so a request renders as one linked
+        arc across threads in Perfetto."""
         with self._lock:
             ring = list(self._ring)
             open_spans = self._open
             dropped = self.dropped
+        pid = os.getpid()
         events: List[Dict[str, Any]] = []
         tids: Dict[str, int] = {}
-        for name, t0, t1, thread, depth, tags in ring:
+        for name, t0, t1, thread, depth, tags, flows in ring:
             tid = tids.setdefault(thread, len(tids))
+            ts = round(t0 * 1e6, 3)
             event = {
                 "name": name,
                 "cat": "host",
                 "ph": "X",
-                "ts": round(t0 * 1e6, 3),
+                "ts": ts,
                 "dur": round((t1 - t0) * 1e6, 3),
-                "pid": 0,
+                "pid": pid,
                 "tid": tid,
             }
             if tags:
@@ -193,15 +222,34 @@ class SpanTracer:
                     for k, v in tags.items()
                 }
             events.append(event)
+            for flow_id, role in flows or ():
+                flow = {
+                    "name": "request",
+                    "cat": "request",
+                    "ph": role,
+                    "id": flow_id,
+                    "ts": ts,
+                    "pid": pid,
+                    "tid": tid,
+                }
+                if role in ("t", "f"):
+                    # bind to the ENCLOSING slice (this span), not the next
+                    flow["bp"] = "e"
+                events.append(flow)
         for thread, tid in tids.items():
             events.append(
-                {"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+                {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
                  "args": {"name": thread}}
             )
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
-            "otherData": {"open_spans": open_spans, "dropped_spans": dropped},
+            "otherData": {
+                "open_spans": open_spans,
+                "dropped_spans": dropped,
+                "pid": pid,
+                "epoch_unix": self.epoch_unix,
+            },
         }
 
     def export(self, path: str) -> None:
@@ -221,17 +269,37 @@ def validate_chrome_trace(trace: Dict[str, Any]) -> List[str]:
     (empty = valid). Accepts the object form (``{"traceEvents": [...]}``).
     Balance means: every duration event is complete (``"X"`` with a
     non-negative ``dur``), any ``"B"``/``"E"`` pairs match per (pid, tid),
-    and the exporter left no span open."""
+    and the exporter left no span open. Flow events (``s``/``t``/``f``)
+    must carry an ``id`` and pair up: a finish (or step) whose flow never
+    started is a violation. A start with no finish is NOT — that is what a
+    request that never reached a device dispatch (cache hit, shed,
+    breaker rejection) legitimately looks like."""
     problems: List[str] = []
     if not isinstance(trace, dict) or not isinstance(trace.get("traceEvents"), list):
         return ["trace is not an object with a traceEvents list"]
     begin_depth: Dict[Tuple[Any, Any], int] = {}
+    flow_started: set = set()
+    flow_continued: Dict[Any, str] = {}
     for i, ev in enumerate(trace["traceEvents"]):
         if not isinstance(ev, dict):
             problems.append(f"event {i} is not an object")
             continue
         ph = ev.get("ph")
         if ph == "M":
+            continue
+        if ph in ("s", "t", "f"):
+            if "id" not in ev:
+                problems.append(f"event {i}: flow event ({ph}) without an id")
+                continue
+            if ph == "s":
+                flow_started.add(ev["id"])
+            else:
+                flow_continued.setdefault(ev["id"], ph)
+            continue
+        if ph == "i":
+            # instant events (trace_merge's access-log / fleet-event marks)
+            if not isinstance(ev.get("ts"), (int, float)):
+                problems.append(f"event {i} (instant) has bad ts {ev.get('ts')!r}")
             continue
         if ph == "X":
             missing = [k for k in _REQUIRED_X_KEYS if k not in ev]
@@ -256,6 +324,12 @@ def validate_chrome_trace(trace: Dict[str, Any]) -> List[str]:
     for key, depth in begin_depth.items():
         if depth > 0:
             problems.append(f"{depth} unclosed 'B' span(s) on {key}")
+    # order-independent pairing: the ring orders events by span COMPLETION,
+    # so a request's "f" (dispatch span, exits first) legitimately precedes
+    # its "s" (the enclosing HTTP span) in the event list
+    for flow_id, ph in flow_continued.items():
+        if flow_id not in flow_started:
+            problems.append(f"flow {flow_id!r} has '{ph}' but no start ('s')")
     open_spans = (trace.get("otherData") or {}).get("open_spans", 0)
     if open_spans:
         problems.append(f"exporter reported {open_spans} span(s) still open")

@@ -460,19 +460,37 @@ def _run_serve_episode(ep: Episode) -> List[str]:
         # HTTP end-to-end: injected dispatch failures trip the breaker; the
         # wire must show 500 -> 500 -> fast 503 (+ Retry-After) and a
         # degraded /healthz — and any 200 must carry a real payload. The
-        # serving.http delay also exercises the handler seam.
+        # serving.http delay also exercises the handler seam. The access-log
+        # invariant rides the same drill: EVERY non-200 response must carry
+        # an X-Request-Id that resolves to a logs/access.jsonl line —
+        # failures are exactly the requests an operator greps for, so they
+        # bypass sampling by contract (observability/context.py).
+        import tempfile
+
         inj = FaultInjector.from_specs(
             ["serving.dispatch=raise:times=2", "serving.http=delay:delay_s=0.01"],
             include_env=False,
         )
         engine = AdaptationEngine(system, system.init_train_state(), injector=inj)
         res = ResilienceConfig(breaker_failure_threshold=2, breaker_cooldown_s=60.0)
-        frontend = ServingFrontend(engine, resilience_cfg=res)
+        access_dir = tempfile.mkdtemp(prefix="chaos_access_")
+        frontend = ServingFrontend(
+            engine, resilience_cfg=res, access_log_dir=access_dir
+        )
         server = make_http_server(frontend, "127.0.0.1", 0)
         thread = threading.Thread(target=server.serve_forever, daemon=True)
         thread.start()
         base = f"http://127.0.0.1:{server.server_address[1]}"
         statuses = []
+        non_200_ids = []  # (status, X-Request-Id) of every failure response
+
+        def _note_failure(code, headers):
+            rid = headers.get("X-Request-Id") if headers is not None else None
+            if rid is None:
+                violations.append(f"non-200 ({code}) without X-Request-Id")
+            else:
+                non_200_ids.append((code, rid))
+
         try:
             for seed in (1, 2, 3):
                 x_s, y_s = support(seed)
@@ -497,6 +515,7 @@ def _run_serve_episode(ep: Episode) -> List[str]:
                         violations.append(f"undocumented HTTP status {exc.code}")
                     if exc.code == 503 and "Retry-After" not in exc.headers:
                         violations.append("503 without Retry-After")
+                    _note_failure(exc.code, exc.headers)
             if statuses != [500, 500, 503]:
                 violations.append(
                     f"breaker wire sequence {statuses} != [500, 500, 503]"
@@ -507,6 +526,7 @@ def _run_serve_episode(ep: Episode) -> List[str]:
             except urllib.error.HTTPError as exc:
                 if exc.code != 503:
                     violations.append(f"healthz {exc.code} while breaker open")
+                _note_failure(exc.code, exc.headers)
             with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
                 json.loads(resp.read())  # must be well-formed
         finally:
@@ -514,6 +534,25 @@ def _run_serve_episode(ep: Episode) -> List[str]:
             server.server_close()
             frontend.close()
             thread.join(timeout=5)
+        # the invariant proper: each failure's request id has an access line
+        from ..observability.context import read_access_log
+
+        access_path = os.path.join(access_dir, "access.jsonl")
+        logged_ids = set()
+        if os.path.exists(access_path):
+            records, torn = read_access_log(access_path)
+            logged_ids = {r.get("trace_id") for r in records}
+            if torn:
+                violations.append(f"{torn} torn access.jsonl line(s)")
+        for code, rid in non_200_ids:
+            if rid not in logged_ids:
+                violations.append(
+                    f"non-200 ({code}) request {rid} has no access-log line"
+                )
+        if not non_200_ids:
+            violations.append(
+                "drill produced no non-200 responses — invariant untested"
+            )
     elif ep.kind == "serve-dispatch-hang":
         # A hanging dispatch must surface as DeadlineExceeded (504-class),
         # never as a 200 or an unbounded wait. after=1 keeps the compile
@@ -730,6 +769,7 @@ def run_campaign(
             "events.jsonl well-formed",
             "serving never 200s a shed/failed payload",
             "telemetry.jsonl well-formed + exported traces balanced",
+            "every non-200 HTTP response has an access-log line with its request id",
         ],
         "episode_results": results,
         "elapsed_s": round(time.time() - t0, 1),

@@ -16,6 +16,7 @@ from collections import OrderedDict
 from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Dict, Hashable, List, Tuple
 
+from ..observability.context import flow_step
 from ..observability.trace import NULL_TRACER
 
 
@@ -42,6 +43,7 @@ class MicroBatcher:
         name: str = "batcher",
         max_queue_depth: int = None,
         tracer=None,
+        pass_contexts: bool = False,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -49,16 +51,23 @@ class MicroBatcher:
         # flush spans on the worker thread (observability/trace.py); the
         # shared NULL_TRACER default keeps the un-instrumented path free
         self._tracer = tracer if tracer is not None else NULL_TRACER
+        # pass_contexts=True widens the flush contract to
+        # ``flush_fn(bucket_key, payloads, ctxs)`` so the engine can finish
+        # each request's flow at its dispatch span (the frontend opts in;
+        # the 2-arg default keeps every existing flush_fn working)
+        self._pass_contexts = bool(pass_contexts)
         self.max_batch = int(max_batch)
         self.deadline_s = float(deadline_ms) / 1000.0
         self.max_queue_depth = None if max_queue_depth is None else int(max_queue_depth)
         self.name = name
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
-        # bucket key -> list of (payload, future, enqueue_time);
+        # bucket key -> list of (payload, future, enqueue_time, ctx);
         # insertion-ordered so the group with the oldest head is flushed
-        # first on deadline
-        self._groups: "OrderedDict[Hashable, List[Tuple[Any, Future, float]]]" = OrderedDict()
+        # first on deadline. ctx (observability/context.py RequestContext,
+        # or None) rides the queue so the flush can stamp each request's
+        # queue wait + flush batch and link its trace flow.
+        self._groups: "OrderedDict[Hashable, List[Tuple[Any, Future, float, Any]]]" = OrderedDict()
         self._closed = False
         self.requests = 0
         self.shed = 0  # submits refused at max_queue_depth
@@ -81,7 +90,7 @@ class MicroBatcher:
 
     # ------------------------------------------------------------------
 
-    def submit(self, bucket_key: Hashable, payload: Any) -> Future:
+    def submit(self, bucket_key: Hashable, payload: Any, ctx=None) -> Future:
         fut: Future = Future()
         with self._wake:
             if self._closed:
@@ -98,7 +107,7 @@ class MicroBatcher:
                     "undispatched) — shedding"
                 )
             self._groups.setdefault(bucket_key, []).append(
-                (payload, fut, time.monotonic())
+                (payload, fut, time.monotonic(), ctx)
             )
             self.requests += 1
             self._wake.notify()
@@ -145,7 +154,7 @@ class MicroBatcher:
 
     # ------------------------------------------------------------------
 
-    def _take_locked(self, key: Hashable) -> List[Tuple[Any, Future, float]]:
+    def _take_locked(self, key: Hashable) -> List[Tuple[Any, Future, float, Any]]:
         """Pop at most ``max_batch`` items off a group's head; the remainder
         stays queued with its own enqueue times (its head ages toward the
         deadline like any other group)."""
@@ -200,7 +209,7 @@ class MicroBatcher:
             # serving/server.py::_dispatch) must not consume device work —
             # and completing it would raise InvalidStateError and kill this
             # worker thread
-            group = [(p, fut, t) for p, fut, t in group if not fut.cancelled()]
+            group = [(p, fut, t, c) for p, fut, t, c in group if not fut.cancelled()]
             if not group:
                 # dropping an all-cancelled group is still worker liveness:
                 # without counting it, a deadline tight enough to cancel
@@ -209,14 +218,30 @@ class MicroBatcher:
                 with self._lock:
                     self.flushes_done += 1
                 continue
-            payloads = [p for p, _, _ in group]
+            payloads = [p for p, _, _, _ in group]
+            # stamp each request's journey through this flush BEFORE the
+            # dispatch: queue wait (enqueue -> worker pickup) and how many
+            # flush-mates it shares the device call with — the numbers a
+            # continuous-batching p99 investigation needs per request
+            pickup = time.monotonic()
+            ctxs = []
+            for _, _, t_enq, c in group:
+                if c is not None:
+                    c.queue_wait_s = pickup - t_enq
+                    c.flush_batch = len(group)
+                ctxs.append(c)
+            flows = flow_step(ctxs)
             with self._lock:
                 self.in_flight = len(group)
             try:
                 with self._tracer.span(
-                    f"serve.flush.{self.name}", batch=len(group), bucket=key
+                    f"serve.flush.{self.name}", flows=flows,
+                    batch=len(group), bucket=key,
                 ):
-                    results = self._flush_fn(key, payloads)
+                    if self._pass_contexts:
+                        results = self._flush_fn(key, payloads, ctxs)
+                    else:
+                        results = self._flush_fn(key, payloads)
                 if len(results) != len(group):
                     raise RuntimeError(
                         f"{self.name} flush_fn returned {len(results)} results "
@@ -226,13 +251,13 @@ class MicroBatcher:
                 with self._lock:
                     self.flushes_done += 1  # an exception is still progress
                     self.in_flight = 0
-                for _, fut, _ in group:
+                for _, fut, _, _ in group:
                     self._complete(fut, exc=exc)
                 continue
             with self._lock:
                 self.flushes_done += 1
                 self.in_flight = 0
-            for (_, fut, _), res in zip(group, results):
+            for (_, fut, _, _), res in zip(group, results):
                 self._complete(fut, result=res)
 
     @staticmethod

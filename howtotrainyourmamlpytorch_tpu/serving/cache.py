@@ -78,17 +78,24 @@ class AdaptedWeightCache:
             self._bytes -= nbytes
             self.expirations += 1
 
-    def get(self, key: CacheKey) -> Optional[Any]:
+    def get(self, key: CacheKey, ctx=None) -> Optional[Any]:
+        """``ctx`` (observability/context.py RequestContext) gets the per-
+        request hit verdict stamped on it — the access log's ``cache_hit``
+        field, attributed at the seam that knows, not re-derived upstream."""
         now = self._clock()
         with self._lock:
             self._expire_locked(now)
             entry = self._entries.get(key)
             if entry is None:
                 self.misses += 1
+                if ctx is not None:
+                    ctx.cache_hit = False
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
-            return entry[0]
+        if ctx is not None:
+            ctx.cache_hit = True
+        return entry[0]
 
     def put(self, key: CacheKey, tree: Any) -> None:
         nbytes = tree_bytes(tree)

@@ -18,6 +18,7 @@ carries.
 
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -27,6 +28,7 @@ import numpy as np
 from ..config import Config, ServingConfig, load_config
 from ..core import MAMLSystem, TrainState
 from ..experiment import checkpoint as ckpt
+from ..observability.context import flow_end
 from ..observability.trace import NULL_TRACER
 from ..resilience.faults import injector_from
 
@@ -317,11 +319,28 @@ class AdaptationEngine:
     # adapt / predict (single and task-batched)
     # ------------------------------------------------------------------
 
-    def adapt_batch(self, items: List[Tuple[Any, Any]]):
+    @staticmethod
+    def _dispatch_flows(ctxs):
+        """Flow-finish pairs for the dispatch span — the request arcs this
+        device call terminates (observability/context.py)."""
+        return flow_end(ctxs) if ctxs else None
+
+    @staticmethod
+    def _stamp_dispatch(ctxs, seconds: float) -> None:
+        """Per-request dispatch attribution: every flush-mate shares the one
+        device call, so each carries its full duration (the Orca lesson —
+        a request's latency IS its flush-mates')."""
+        for c in ctxs or ():
+            if c is not None:
+                c.dispatch_s = seconds
+
+    def adapt_batch(self, items: List[Tuple[Any, Any]], ctxs=None):
         """Adapt a same-bucket group of support sets in one device dispatch.
         ``items`` is a list of ``(x_support, y_support)``; returns one
         adapted-parameter pytree per item (device arrays, stackable into the
-        cache)."""
+        cache). ``ctxs`` (one RequestContext-or-None per item, threaded
+        through the batcher) get the dispatch seconds stamped and their
+        trace flows finished at the dispatch span."""
         self.injector.fire("serving.dispatch")
         flat = [self._flatten_support(x, y) for x, y in items]
         sizes = {x.shape[0] for x, _ in flat}
@@ -339,19 +358,25 @@ class AdaptationEngine:
         while len(xs) < b:  # pad the task axis by replicating the last task
             xs.append(xs[-1]); ys.append(ys[-1]); ws.append(ws[-1])
         fn = self._compiled_adapt(bucket, b)
-        with self.tracer.span("serve.adapt_dispatch", batch=n, bucket=bucket):
+        t0 = time.monotonic()
+        with self.tracer.span(
+            "serve.adapt_dispatch", flows=self._dispatch_flows(ctxs),
+            batch=n, bucket=bucket,
+        ):
             stacked = fn(np.stack(xs), np.stack(ys), np.stack(ws))
+        self._stamp_dispatch(ctxs, time.monotonic() - t0)
         return [jax.tree.map(lambda a, i=i: a[i], stacked) for i in range(n)]
 
     def adapt(self, x_support, y_support):
         """Single-task convenience wrapper over :meth:`adapt_batch`."""
         return self.adapt_batch([(x_support, y_support)])[0]
 
-    def predict_batch(self, items: List[Tuple[Any, Any]]) -> List[np.ndarray]:
+    def predict_batch(self, items: List[Tuple[Any, Any]], ctxs=None) -> List[np.ndarray]:
         """Forward a same-bucket group of query batches, each through its own
         adapted weights, in one device dispatch. ``items`` is a list of
         ``(fast_weights, x_query)``; returns per-item softmax probabilities
-        [Q_i, num_classes] as host arrays, padding sliced off."""
+        [Q_i, num_classes] as host arrays, padding sliced off. ``ctxs`` as
+        in :meth:`adapt_batch`."""
         self.injector.fire("serving.dispatch")
         # parses host-side request payloads (JSON-decoded lists), not device
         # values  # graftlint: disable=GL110
@@ -370,11 +395,16 @@ class AdaptationEngine:
             xs.append(xs[-1]); ws.append(ws[-1]); trees.append(trees[-1])
         stacked_fw = jax.tree.map(lambda *leaves: jnp.stack(leaves), *trees)
         fn = self._compiled_predict(bucket, b)
-        with self.tracer.span("serve.predict_dispatch", batch=n, bucket=bucket):
+        t0 = time.monotonic()
+        with self.tracer.span(
+            "serve.predict_dispatch", flows=self._dispatch_flows(ctxs),
+            batch=n, bucket=bucket,
+        ):
             # deliberate sync: predictions must land host-side to serialize
             # back to clients — this is the flush's one device round-trip
             # graftlint: disable=GL110
             probs = np.asarray(fn(stacked_fw, np.stack(xs), np.stack(ws)))
+        self._stamp_dispatch(ctxs, time.monotonic() - t0)
         return [probs[i, : sizes[i]] for i in range(n)]
 
     def predict(self, fast_weights, x_query) -> np.ndarray:

@@ -25,6 +25,7 @@ import os
 import sys
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
@@ -34,6 +35,15 @@ import numpy as np
 from ..config import Config, ResilienceConfig, ServingConfig
 from ..exit_codes import HTTP_DEADLINE, HTTP_UNAVAILABLE
 from ..observability import TelemetryHub
+from ..observability.context import (
+    AccessLog,
+    RequestContext,
+    flow_start,
+    format_traceparent,
+    new_request_context,
+    parse_traceparent,
+)
+from ..observability.metrics import prometheus_text
 from ..observability.trace import NULL_TRACER
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.retry import DeadlineExceededError
@@ -67,6 +77,7 @@ class ServingFrontend:
         clock=time.monotonic,
         wedge_exit=None,
         hub: Optional[TelemetryHub] = None,
+        access_log_dir: Optional[str] = None,
     ):
         self.engine = engine
         self.serving = serving_cfg or engine.serving
@@ -92,6 +103,19 @@ class ServingFrontend:
         )
         self.counters = EventCounters(registry=self.hub.registry)
         self._memory = None
+        # structured access log (observability/context.py): one JSON line
+        # per request in <access_log_dir>/access.jsonl. Only built when the
+        # caller names a directory (a frontend owns no run dir by itself;
+        # from_run_dir / serve.py / loadgen pass one) AND observability is
+        # on — disabled, the request path stays zero-file.
+        self.access_log: Optional[AccessLog] = None
+        if self.hub.enabled and access_log_dir:
+            obs_cfg = getattr(engine.cfg, "observability", None)
+            if getattr(obs_cfg, "access_log", True):
+                self.access_log = AccessLog(
+                    access_log_dir,
+                    sample=getattr(obs_cfg, "access_log_sample", 1.0),
+                )
         if self.hub.enabled:
             # trace the engine's device dispatches and both batchers' flushes
             # through the hub's tracer (engines built standalone keep their
@@ -130,21 +154,30 @@ class ServingFrontend:
             timeout_threshold=self.resilience.breaker_timeout_threshold,
             clock=clock,
         )
+        # pass_contexts: the request contexts ride the queue with their
+        # payloads so the flush stamps queue-wait/flush-batch and the engine
+        # finishes each trace flow at its dispatch span
         self._adapt_batcher = MicroBatcher(
-            lambda bucket, payloads: self.engine.adapt_batch(payloads),
+            lambda bucket, payloads, ctxs: self.engine.adapt_batch(
+                payloads, ctxs=ctxs
+            ),
             max_batch=self.serving.max_batch_size,
             deadline_ms=self.serving.batch_deadline_ms,
             name="adapt",
             max_queue_depth=self.resilience.max_queue_depth,
             tracer=self.hub.tracer,
+            pass_contexts=True,
         )
         self._predict_batcher = MicroBatcher(
-            lambda bucket, payloads: self.engine.predict_batch(payloads),
+            lambda bucket, payloads, ctxs: self.engine.predict_batch(
+                payloads, ctxs=ctxs
+            ),
             max_batch=self.serving.max_batch_size,
             deadline_ms=self.serving.batch_deadline_ms,
             name="predict",
             max_queue_depth=self.resilience.max_queue_depth,
             tracer=self.hub.tracer,
+            pass_contexts=True,
         )
         self._started = time.monotonic()
         self._closed = False
@@ -276,7 +309,57 @@ class ServingFrontend:
     def _cache_key(self, digest: str) -> Tuple[str, str]:
         return (self.engine.fingerprint, digest)
 
-    def _dispatch(self, batcher: MicroBatcher, bucket, payload):
+    def _request_ctx(self, ctx: Optional[RequestContext]) -> Optional[RequestContext]:
+        """The per-request trace identity: adopt the caller's (HTTP layer,
+        loadgen), mint one when observability is on, stay None (and
+        zero-overhead) when it is off."""
+        if ctx is not None or not self.hub.enabled:
+            return ctx
+        return new_request_context()
+
+    def _record_access(
+        self,
+        ctx: Optional[RequestContext],
+        verb: str,
+        outcome: str,
+        status: int,
+        total_s: float,
+    ) -> None:
+        if ctx is None or self.access_log is None:
+            return
+        self.access_log.record(
+            ctx, verb, outcome, status, total_s, breaker=self.breaker.state
+        )
+
+    def log_http_access(
+        self,
+        ctx: Optional[RequestContext],
+        verb: str,
+        outcome: str,
+        status: int,
+        total_s: float,
+    ) -> None:
+        """HTTP-layer seam for requests the frontend methods never saw —
+        parse errors, unknown paths, handler-level faults, degraded
+        /healthz. ``ctx.access_logged`` guards double-logging the ones the
+        frontend already recorded."""
+        if ctx is None or ctx.access_logged:
+            return
+        self._record_access(ctx, verb, outcome, status, total_s)
+
+    @staticmethod
+    def _failure_of(exc: BaseException) -> Tuple[str, int]:
+        """Map a request-path exception to its (outcome, HTTP status) pair
+        — the access log's taxonomy, identical in-process and over HTTP."""
+        if isinstance(exc, ServiceUnavailableError):
+            return "shed", HTTP_UNAVAILABLE
+        if isinstance(exc, DeadlineExceededError):
+            return "deadline", HTTP_DEADLINE
+        if isinstance(exc, UnknownAdaptationError):
+            return "unknown_id", 404
+        return "error", 500
+
+    def _dispatch(self, batcher: MicroBatcher, bucket, payload, ctx=None):
         """One guarded device dispatch: circuit breaker (fail fast while the
         device path is known-bad), queue-depth shed (bounded tail latency),
         per-request deadline (no caller waits forever on a wedged device).
@@ -299,7 +382,7 @@ class ServingFrontend:
         # while we wait counts as progress when attributing a timeout below
         progress_mark = batcher.flushes_completed()
         try:
-            fut = batcher.submit(bucket, payload)
+            fut = batcher.submit(bucket, payload, ctx=ctx)
         except QueueFullError as exc:
             # never dispatched: a half-open probe slot this call consumed
             # must be returned or the breaker wedges in half_open (the permit
@@ -338,44 +421,91 @@ class ServingFrontend:
         self.breaker.record_success(permit)
         return result
 
-    def adapt(self, x_support, y_support) -> Dict[str, Any]:
+    def adapt(self, x_support, y_support, ctx: Optional[RequestContext] = None) -> Dict[str, Any]:
+        ctx = self._request_ctx(ctx)
         t0 = time.monotonic()
-        with self.hub.span("serve.adapt"):
-            x, y = self.engine._flatten_support(x_support, y_support)
-            digest = support_digest(x, y, self.engine.num_steps)
-            key = self._cache_key(digest)
-            cached = self.cache.get(key) is not None
-            if not cached:
-                bucket = self.engine.support_bucket(x.shape[0])
-                fast_weights = self._dispatch(self._adapt_batcher, bucket, (x, y))
-                self.cache.put(key, fast_weights)
+        try:
+            # the request's flow STARTS here (ph "s"); the batcher flush
+            # steps it ("t") and the engine dispatch finishes it ("f") — one
+            # linked arc HTTP thread -> worker flush -> device dispatch
+            with self.hub.span(
+                "serve.adapt", flows=flow_start(ctx),
+                trace=ctx.trace_id if ctx else None,
+            ):
+                x, y = self.engine._flatten_support(x_support, y_support)
+                digest = support_digest(x, y, self.engine.num_steps)
+                key = self._cache_key(digest)
+                cached = self.cache.get(key, ctx=ctx) is not None
+                if not cached:
+                    bucket = self.engine.support_bucket(x.shape[0])
+                    if ctx is not None:
+                        ctx.bucket = bucket
+                    fast_weights = self._dispatch(
+                        self._adapt_batcher, bucket, (x, y), ctx
+                    )
+                    self.cache.put(key, fast_weights)
+        except BaseException as exc:
+            outcome, status = self._failure_of(exc)
+            self._record_access(ctx, "adapt", outcome, status, time.monotonic() - t0)
+            raise
         elapsed = time.monotonic() - t0
         self.latency.record("adapt_cached" if cached else "adapt", elapsed)
-        return {
+        self._record_access(ctx, "adapt", "ok", 200, elapsed)
+        out = {
             "adaptation_id": digest,
             "cached": cached,
             "support_size": int(x.shape[0]),
             "latency_ms": round(elapsed * 1e3, 3),
         }
+        if ctx is not None:
+            out["trace_id"] = ctx.trace_id
+            out["timing"] = ctx.timing_ms(elapsed)
+        return out
 
-    def predict(self, adaptation_id: str, x_query) -> np.ndarray:
+    def predict(self, adaptation_id: str, x_query, ctx: Optional[RequestContext] = None) -> np.ndarray:
+        ctx = self._request_ctx(ctx)
         t0 = time.monotonic()
-        with self.hub.span("serve.predict"):
-            fast_weights = self.cache.get(self._cache_key(adaptation_id))
-            if fast_weights is None:
-                raise UnknownAdaptationError(
-                    f"unknown or expired adaptation_id {adaptation_id!r}; "
-                    "re-send the support set via /adapt"
+        try:
+            with self.hub.span(
+                "serve.predict", flows=flow_start(ctx),
+                trace=ctx.trace_id if ctx else None,
+            ):
+                fast_weights = self.cache.get(self._cache_key(adaptation_id), ctx=ctx)
+                if fast_weights is None:
+                    raise UnknownAdaptationError(
+                        f"unknown or expired adaptation_id {adaptation_id!r}; "
+                        "re-send the support set via /adapt"
+                    )
+                x = np.asarray(x_query, np.float32)
+                bucket = self.engine.query_bucket(x.shape[0])
+                if ctx is not None:
+                    ctx.bucket = bucket
+                probs = self._dispatch(
+                    self._predict_batcher, bucket, (fast_weights, x), ctx
                 )
-            x = np.asarray(x_query, np.float32)
-            bucket = self.engine.query_bucket(x.shape[0])
-            probs = self._dispatch(self._predict_batcher, bucket, (fast_weights, x))
-        self.latency.record("predict", time.monotonic() - t0)
+        except BaseException as exc:
+            outcome, status = self._failure_of(exc)
+            self._record_access(ctx, "predict", outcome, status, time.monotonic() - t0)
+            raise
+        elapsed = time.monotonic() - t0
+        self.latency.record("predict", elapsed)
+        self._record_access(ctx, "predict", "ok", 200, elapsed)
         return probs
 
-    def adapt_predict(self, x_support, y_support, x_query) -> Dict[str, Any]:
-        info = self.adapt(x_support, y_support)
-        probs = self.predict(info["adaptation_id"], x_query)
+    def adapt_predict(self, x_support, y_support, x_query, ctx: Optional[RequestContext] = None) -> Dict[str, Any]:
+        # one client call, two hops: both access-log lines (verb adapt +
+        # verb predict) share the request's trace id
+        ctx = self._request_ctx(ctx)
+        t0 = time.monotonic()
+        info = self.adapt(x_support, y_support, ctx=ctx)
+        if ctx is not None:
+            ctx.access_logged = False  # the predict hop logs its own line
+        probs = self.predict(info["adaptation_id"], x_query, ctx=ctx)
+        if ctx is not None:
+            # adapt() stamped an adapt-hop-only breakdown into info; the
+            # response must describe the WHOLE request (queue/dispatch from
+            # the predict hop — the adapt hop's detail is its access line)
+            info["timing"] = ctx.timing_ms(time.monotonic() - t0)
         return {**info, "probs": probs}
 
     # ------------------------------------------------------------------
@@ -412,7 +542,7 @@ class ServingFrontend:
         }
 
     def metrics(self) -> Dict[str, Any]:
-        return {
+        out = {
             "prewarm": self.prewarm_status(),
             "latency": self.latency.summary(),
             "cache": self.cache.stats(),
@@ -426,6 +556,18 @@ class ServingFrontend:
             },
             "uptime_s": round(time.monotonic() - self._started, 1),
         }
+        if self.access_log is not None:
+            out["access_log"] = self.access_log.stats()
+        if self._memory is not None:
+            # HBM watermarks on the scrape surface too (obs_top reads them
+            # live), not only inside hub snapshots
+            out["memory"] = self._memory.snapshot()
+        return out
+
+    def metrics_prometheus(self) -> str:
+        """The ``/metrics?format=prom`` body: OpenMetrics text over the one
+        registry that backs every serving number."""
+        return prometheus_text(self.hub.registry)
 
     def close(self) -> None:
         if self._closed:
@@ -435,13 +577,19 @@ class ServingFrontend:
             wd.stop()
         self._adapt_batcher.close()
         self._predict_batcher.close()
+        if self.access_log is not None:
+            self.access_log.close()
 
 
 def frontend_from_run_dir(
     run_dir: str, checkpoint_idx="best", cfg: Optional[Config] = None
 ) -> ServingFrontend:
     engine = AdaptationEngine.from_run_dir(run_dir, checkpoint_idx, cfg=cfg)
-    return ServingFrontend(engine)
+    # a run-dir frontend owns the run's logs/: access.jsonl lands next to
+    # telemetry.jsonl and events.jsonl so trace_merge finds them together
+    return ServingFrontend(
+        engine, access_log_dir=os.path.join(run_dir, "logs")
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -452,6 +600,10 @@ def frontend_from_run_dir(
 class _Handler(BaseHTTPRequestHandler):
     # the frontend is attached to the server instance by make_http_server
     protocol_version = "HTTP/1.1"
+    # per-request context/clock, reset by _begin_request at the top of every
+    # handler (one instance serves a keep-alive connection sequentially)
+    _ctx: Optional[RequestContext] = None
+    _t0: float = 0.0
 
     def _send_json(
         self, code: int, payload: Dict[str, Any], headers: Optional[Dict[str, str]] = None
@@ -460,10 +612,25 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if self._ctx is not None:
+            # every response names its request: the grep handle joining the
+            # wire, access.jsonl, and the exported trace flows
+            self.send_header("X-Request-Id", self._ctx.trace_id)
+            self.send_header("traceparent", format_traceparent(self._ctx))
         for name, value in (headers or {}).items():
             self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_text(self, code: int, body: str, content_type: str) -> None:
+        raw = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
+        if self._ctx is not None:
+            self.send_header("X-Request-Id", self._ctx.trace_id)
+        self.end_headers()
+        self.wfile.write(raw)
 
     def _read_json(self) -> Dict[str, Any]:
         length = int(self.headers.get("Content-Length", 0))
@@ -471,13 +638,40 @@ class _Handler(BaseHTTPRequestHandler):
             return {}
         return json.loads(self.rfile.read(length))
 
-    def log_message(self, fmt, *args):  # quiet by default; metrics cover it
+    def _begin_request(self, frontend: "ServingFrontend"):
+        """Adopt/mint the request context (W3C ``traceparent``) and start
+        the per-request clock. None when observability is off — the
+        request path must stay bit-identical to the un-instrumented build
+        (no extra headers, no body keys, no files)."""
+        self._t0 = time.monotonic()
+        self._ctx = (
+            parse_traceparent(self.headers.get("traceparent"))
+            if frontend.hub.enabled
+            else None
+        )
+        return self._ctx
+
+    def _log_http(self, frontend, outcome: str, status: int) -> None:
+        """Access-log a terminal HTTP outcome the frontend methods never
+        saw (no-op for the ones they did — ``ctx.access_logged``)."""
+        frontend.log_http_access(
+            self._ctx, self.path, outcome, status, time.monotonic() - self._t0
+        )
+
+    def log_message(self, fmt, *args):
+        # quiet by default: the STRUCTURED access log (logs/access.jsonl,
+        # observability/context.py) carries what these lines would, plus
+        # the trace id / timing breakdown stdlib lines cannot
         pass
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         frontend: ServingFrontend = self.server.frontend  # type: ignore[attr-defined]
+        ctx = self._begin_request(frontend)
         try:
-            if self.path == "/healthz":
+            split = urllib.parse.urlsplit(self.path)
+            path = split.path
+            query = urllib.parse.parse_qs(split.query)
+            if path == "/healthz":
                 health = frontend.healthz()
                 # 503 while the breaker is OPEN (drain a failing device) or
                 # while the AOT prewarm is still compiling (hold traffic off
@@ -491,16 +685,30 @@ class _Handler(BaseHTTPRequestHandler):
                     or health["status"] == "warming"
                     else 200
                 )
+                if code != 200:
+                    # the chaos invariant: every non-200 response has an
+                    # access-log line carrying its request id
+                    self._log_http(frontend, health["status"], code)
                 self._send_json(code, health)
-            elif self.path == "/metrics":
-                self._send_json(200, frontend.metrics())
+            elif path == "/metrics":
+                if query.get("format") == ["prom"]:
+                    self._send_text(
+                        200,
+                        frontend.metrics_prometheus(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                else:
+                    self._send_json(200, frontend.metrics())
             else:
+                self._log_http(frontend, "not_found", 404)
                 self._send_json(404, {"error": f"unknown path {self.path}"})
         except Exception as exc:  # noqa: BLE001 — keep the server alive
+            self._log_http(frontend, "error", 500)
             self._send_json(500, {"error": f"internal error: {exc!r}"})
 
     def do_POST(self):  # noqa: N802
         frontend: ServingFrontend = self.server.frontend  # type: ignore[attr-defined]
+        ctx = self._begin_request(frontend)
         try:
             # fault seam for handler-level drills (raise -> 500, delay) —
             # fired AFTER the body is drained so an injected 500 on a
@@ -509,18 +717,23 @@ class _Handler(BaseHTTPRequestHandler):
             req = self._read_json()
             frontend.engine.injector.fire("serving.http")
             if self.path == "/adapt":
-                out = frontend.adapt(req["x_support"], req["y_support"])
+                out = frontend.adapt(req["x_support"], req["y_support"], ctx=ctx)
                 self._send_json(200, out)
             elif self.path == "/predict":
-                probs = frontend.predict(req["adaptation_id"], req["x_query"])
-                self._send_json(200, {"probs": probs.tolist()})
+                probs = frontend.predict(req["adaptation_id"], req["x_query"], ctx=ctx)
+                body = {"probs": probs.tolist()}
+                if ctx is not None:
+                    body["trace_id"] = ctx.trace_id
+                    body["timing"] = ctx.timing_ms(time.monotonic() - self._t0)
+                self._send_json(200, body)
             elif self.path == "/adapt_predict":
                 out = frontend.adapt_predict(
-                    req["x_support"], req["y_support"], req["x_query"]
+                    req["x_support"], req["y_support"], req["x_query"], ctx=ctx
                 )
                 out["probs"] = out["probs"].tolist()
                 self._send_json(200, out)
             else:
+                self._log_http(frontend, "not_found", 404)
                 self._send_json(404, {"error": f"unknown path {self.path}"})
         except ServiceUnavailableError as exc:
             # load shed / breaker open: tell the client when to come back
@@ -536,8 +749,10 @@ class _Handler(BaseHTTPRequestHandler):
         except UnknownAdaptationError as exc:
             self._send_json(404, {"error": str(exc)})
         except (KeyError, ValueError, TypeError) as exc:
+            self._log_http(frontend, "bad_request", 400)
             self._send_json(400, {"error": f"bad request: {exc!r}"})
         except Exception as exc:  # noqa: BLE001 — keep the server alive
+            self._log_http(frontend, "error", 500)
             self._send_json(500, {"error": f"internal error: {exc!r}"})
 
 

@@ -65,6 +65,16 @@ def main(argv=None) -> int:
                         help="full Conv-4 backbone (default: tiny 2-stage CI shape)")
     parser.add_argument("--max-workers", type=int, default=16)
     parser.add_argument(
+        "--access-log-dir", default="logs",
+        help="directory for the structured access log (access.jsonl; one "
+        "line per request with trace id + per-hop timing). '' disables. "
+        "With --run-dir the run's own logs/ is used instead.",
+    )
+    parser.add_argument(
+        "--worst-k", type=int, default=5,
+        help="how many worst request ids each failing stair names",
+    )
+    parser.add_argument(
         "--print-schedule", action="store_true",
         help="emit the request schedule as one JSON line and exit "
         "(no backend contact; the determinism-check surface)",
@@ -124,6 +134,7 @@ def main(argv=None) -> int:
     if args.run_dir:
         from howtotrainyourmamlpytorch_tpu.serving.server import frontend_from_run_dir
 
+        # from_run_dir already points access.jsonl at the run's own logs/
         frontend = frontend_from_run_dir(args.run_dir)
         cfg = frontend.engine.cfg
         n_way = cfg.num_classes_per_set
@@ -147,7 +158,8 @@ def main(argv=None) -> int:
             model=build_vgg(img, n_way, num_stages=stages, cnn_num_filters=filters),
         )
         frontend = ServingFrontend(
-            AdaptationEngine(system, system.init_train_state())
+            AdaptationEngine(system, system.init_train_state()),
+            access_log_dir=args.access_log_dir or None,
         )
         model_label = f"vgg{stages}x{filters}"
     img_shape = cfg.image_shape if args.run_dir else (28, 28, 1)
@@ -189,10 +201,28 @@ def main(argv=None) -> int:
         max_shed_rate=args.max_shed_rate,
         metric_suffix=f"_{n_way}w{k_shot}s",
         platform=jax.default_backend(),
+        worst_k=args.worst_k,
+        # join the access log back in: each failing stair's worst request
+        # ids carry their queue-wait/dispatch/flush-batch breakdown
+        access_log_path=(
+            frontend.access_log.path if frontend.access_log is not None else None
+        ),
         model=model_label,
         adapt_frac=args.adapt_frac,
         schedule_digest=slo.schedule_digest(schedule),
     )
+    if frontend.access_log is not None and frontend.hub.enabled:
+        # the flow-linked span trace lands NEXT TO access.jsonl, so a worst
+        # request id from the report is one grep away from its arc (and
+        # trace_merge finds the pair together)
+        trace_path = os.path.join(
+            os.path.dirname(frontend.access_log.path), "trace.json"
+        )
+        try:
+            frontend.hub.tracer.export(trace_path)
+            report["trace_path"] = trace_path
+        except OSError as exc:
+            log(f"loadgen: trace export failed (continuing): {exc}")
     frontend.close()
     print(json.dumps(report), flush=True)
     return 0

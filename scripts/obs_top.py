@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Live ops console: one refreshing terminal frame over /metrics or
+telemetry.jsonl.
+
+``top`` for a live run: qps, latency p50/p99, queue depth, shed/breaker
+state, MFU, HBM headroom — the numbers an operator watches during a loadgen
+stair or a training run, without opening Perfetto or tailing three jsonl
+files. Two sources:
+
+- ``--url http://host:port`` — poll a live serving frontend's ``/metrics``
+  JSON (request latencies, batcher queue depths, shed/deadline/breaker
+  counters, cache hit rate, prewarm status, access-log line count). QPS is
+  the completed-request delta between consecutive polls.
+- ``--run-dir exps/<run>`` — tail ``logs/telemetry.jsonl`` (the hub's
+  latest snapshot: step-phase percentiles, episodes/s, MFU, HBM headroom,
+  watchdog beat age).
+
+One frame per ``--interval`` seconds (ANSI clear in between), forever until
+Ctrl-C, or ``--frames N`` / ``--once`` for a bounded run. ``--json`` emits
+each frame as one JSON line instead of the ANSI table (scripting/tests).
+
+Import-light by design (stdlib only; no jax): a console over a run must
+never touch — or wait on — a backend.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO_ROOT, "howtotrainyourmamlpytorch_tpu")
+
+
+def _load_by_path(name: str, path: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+try:
+    _exit_codes = _load_by_path("htymp_exit_codes", os.path.join(_PKG, "exit_codes.py"))
+    _RC_OK, _RC_USAGE = _exit_codes.OK, _exit_codes.USAGE
+except Exception:  # standalone copy of scripts/: the historical literals hold
+    _RC_OK, _RC_USAGE = 0, 2
+
+#: how far back to read telemetry.jsonl for the latest snapshot — a long
+#: run's file can be MBs; the last snapshot lives in the final lines
+_TAIL_BYTES = 256 * 1024
+
+
+def _fetch_metrics(url: str, timeout_s: float) -> Dict[str, Any]:
+    with urllib.request.urlopen(url.rstrip("/") + "/metrics", timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def _tail_jsonl_last(path: str) -> Optional[Dict[str, Any]]:
+    """Last parseable JSON line of a (possibly huge, possibly torn) jsonl."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - _TAIL_BYTES))
+            chunk = f.read().decode("utf-8", "replace")
+    except OSError:
+        return None
+    for line in reversed(chunk.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+# ---------------------------------------------------------------------------
+# frame builders: source payload -> one flat display dict
+# ---------------------------------------------------------------------------
+
+
+def _requests_completed(metrics: Dict[str, Any]) -> int:
+    """Completed requests = cumulative latency-histogram counts (every
+    outcome the frontend timed), the QPS numerator."""
+    return sum(
+        int(phase.get("count", 0))
+        for phase in (metrics.get("latency") or {}).values()
+        if isinstance(phase, dict)
+    )
+
+
+def serving_frame(
+    metrics: Dict[str, Any], prev: Optional[Dict[str, Any]], interval_s: float
+) -> Dict[str, Any]:
+    """One console frame from a /metrics JSON payload (``prev`` = the
+    previous frame, for the completed-requests QPS delta)."""
+    latency = metrics.get("latency") or {}
+    resilience = metrics.get("resilience") or {}
+    breaker = resilience.get("breaker") or {}
+    completed = _requests_completed(metrics)
+    qps = None
+    # delta only against a frame that actually measured (an error frame —
+    # transient fetch failure — has no _completed; a delta against its
+    # default 0 would render the lifetime total as one bogus qps spike)
+    if prev is not None and prev.get("_completed") is not None and interval_s > 0:
+        qps = round(max(0, completed - prev["_completed"]) / interval_s, 2)
+    frame: Dict[str, Any] = {
+        "source": "serving",
+        "uptime_s": metrics.get("uptime_s"),
+        "qps": qps,
+        "requests": completed,
+        "latency": {
+            phase: {k: stats.get(k) for k in ("p50_ms", "p99_ms", "count")}
+            for phase, stats in latency.items()
+            if isinstance(stats, dict)
+        },
+        "queue_depth": {
+            name: (metrics.get(f"{name}_batcher") or {}).get("queue_depth")
+            for name in ("adapt", "predict")
+        },
+        "shed": resilience.get("shed", 0),
+        "deadline_exceeded": resilience.get("deadline_exceeded", 0),
+        "breaker": breaker.get("state"),
+        "breaker_opens": breaker.get("opens", 0),
+        "cache_hit_rate": (metrics.get("cache") or {}).get("hit_rate"),
+        "prewarm": (metrics.get("prewarm") or {}).get("status"),
+        "access_log_lines": (metrics.get("access_log") or {}).get("lines"),
+        "hbm_headroom_frac": _min_headroom(metrics.get("memory")),
+        "_completed": completed,
+    }
+    return frame
+
+
+def _min_headroom(memory: Optional[Dict[str, Any]]) -> Optional[float]:
+    """Tightest per-device HBM headroom fraction in a MemoryWatermarks
+    snapshot (it pre-aggregates ``headroom_frac_min``; fall back to the
+    device rows for older payloads)."""
+    if not isinstance(memory, dict):
+        return None
+    if isinstance(memory.get("headroom_frac_min"), (int, float)):
+        return round(memory["headroom_frac_min"], 4)
+    fracs = [
+        dev.get("headroom_frac")
+        for dev in (memory.get("devices") or [])
+        if isinstance(dev, dict) and isinstance(dev.get("headroom_frac"), (int, float))
+    ]
+    return round(min(fracs), 4) if fracs else None
+
+
+def telemetry_frame(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    """One console frame from the latest telemetry.jsonl snapshot."""
+    providers = snapshot.get("providers") or {}
+    phases = snapshot.get("phases") or {}
+    watchdog = providers.get("watchdog") or {}
+    return {
+        "source": "telemetry",
+        "kind": snapshot.get("kind"),
+        "session": snapshot.get("session"),
+        "elapsed_s": snapshot.get("elapsed_s"),
+        "steps": snapshot.get("steps"),
+        "episodes_per_s": snapshot.get("interval_episodes_per_s")
+        or snapshot.get("episodes_per_s"),
+        "mfu": snapshot.get("mfu"),
+        "phases": {
+            name: {k: stats.get(k) for k in ("p50_ms", "p95_ms", "count")}
+            for name, stats in phases.items()
+            if isinstance(stats, dict)
+        },
+        "hbm_headroom_frac": _min_headroom(providers.get("memory")),
+        "watchdog_beat_age_s": watchdog.get("beat_age_s"),
+        "dropped_spans": snapshot.get("dropped_spans"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3f}".rstrip("0").rstrip(".")
+    return str(v)
+
+
+def render(frame: Dict[str, Any]) -> str:
+    """The human frame: a few aligned lines, widest numbers first."""
+    lines: List[str] = []
+    if frame.get("error"):
+        return f"obs_top: {frame['error']}"
+    if frame["source"] == "serving":
+        lines.append(
+            f"serving  up {_fmt(frame['uptime_s'])}s   qps {_fmt(frame['qps'])}   "
+            f"requests {_fmt(frame['requests'])}   prewarm {_fmt(frame['prewarm'])}"
+        )
+        lines.append(
+            f"queue    adapt {_fmt(frame['queue_depth']['adapt'])}  "
+            f"predict {_fmt(frame['queue_depth']['predict'])}   "
+            f"shed {_fmt(frame['shed'])}   504 {_fmt(frame['deadline_exceeded'])}   "
+            f"breaker {_fmt(frame['breaker'])} (opens {_fmt(frame['breaker_opens'])})"
+        )
+        lines.append(
+            f"cache    hit_rate {_fmt(frame['cache_hit_rate'])}   "
+            f"access_log {_fmt(frame['access_log_lines'])} lines   "
+            f"hbm_headroom {_fmt(frame['hbm_headroom_frac'])}"
+        )
+        for phase, stats in sorted((frame.get("latency") or {}).items()):
+            lines.append(
+                f"  {phase:<14} p50 {_fmt(stats['p50_ms'])} ms   "
+                f"p99 {_fmt(stats['p99_ms'])} ms   n {_fmt(stats['count'])}"
+            )
+    else:
+        lines.append(
+            f"train    {_fmt(frame['kind'])}@{_fmt(frame['elapsed_s'])}s   "
+            f"steps {_fmt(frame['steps'])}   eps/s {_fmt(frame['episodes_per_s'])}   "
+            f"mfu {_fmt(frame['mfu'])}"
+        )
+        lines.append(
+            f"health   hbm_headroom {_fmt(frame['hbm_headroom_frac'])}   "
+            f"beat_age {_fmt(frame['watchdog_beat_age_s'])}s   "
+            f"dropped_spans {_fmt(frame['dropped_spans'])}"
+        )
+        for phase, stats in sorted((frame.get("phases") or {}).items()):
+            lines.append(
+                f"  {phase:<14} p50 {_fmt(stats['p50_ms'])} ms   "
+                f"p95 {_fmt(stats['p95_ms'])} ms   n {_fmt(stats['count'])}"
+            )
+    return "\n".join(lines)
+
+
+def build_frame(
+    args, prev: Optional[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """One poll of the configured source, degraded to an ``error`` frame on
+    an unreachable backend / missing file — the console keeps refreshing
+    through a restart instead of dying mid-incident."""
+    if args.url:
+        try:
+            metrics = _fetch_metrics(args.url, args.timeout_s)
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as exc:
+            return {"source": "serving", "error": f"{args.url} unreachable: {exc}"}
+        return serving_frame(metrics, prev, args.interval)
+    path = os.path.join(args.run_dir, "logs", "telemetry.jsonl")
+    snapshot = _tail_jsonl_last(path)
+    if snapshot is None:
+        return {"source": "telemetry", "error": f"no parseable snapshot in {path}"}
+    return telemetry_frame(snapshot)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    source = parser.add_mutually_exclusive_group(required=True)
+    source.add_argument("--url", default=None,
+                        help="live serving frontend base URL (polls /metrics)")
+    source.add_argument("--run-dir", default=None,
+                        help="experiment dir (tails logs/telemetry.jsonl)")
+    parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument("--frames", type=int, default=0,
+                        help="stop after N frames (0 = until Ctrl-C)")
+    parser.add_argument("--once", action="store_true",
+                        help="one frame, no ANSI clear (same as --frames 1)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit each frame as one JSON line (no ANSI)")
+    parser.add_argument("--timeout-s", type=float, default=5.0,
+                        help="/metrics fetch timeout per poll")
+    args = parser.parse_args(argv)
+    if args.interval <= 0:
+        print("obs_top: --interval must be > 0", file=sys.stderr)
+        return _RC_USAGE
+    max_frames = 1 if args.once else args.frames
+
+    prev: Optional[Dict[str, Any]] = None
+    shown = 0
+    try:
+        while True:
+            frame = build_frame(args, prev)
+            if args.json:
+                public = {k: v for k, v in frame.items() if not k.startswith("_")}
+                print(json.dumps(public), flush=True)
+            else:
+                if shown and max_frames != 1:
+                    # clear + home between frames; never for a single shot
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(render(frame), flush=True)
+            prev = frame
+            shown += 1
+            if max_frames and shown >= max_frames:
+                return _RC_OK
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return _RC_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,7 +51,11 @@ def build_frontend(
     alone cannot reconstruct (e.g. shrunken test backbones)."""
     cfg = load_config(os.path.join(run_dir, "config.yaml"), overrides or [])
     engine = AdaptationEngine.from_run_dir(run_dir, checkpoint, cfg=cfg, system=system)
-    return ServingFrontend(engine)
+    # access.jsonl lands in the run's logs/ next to telemetry.jsonl so
+    # scripts/trace_merge.py finds the pair together
+    return ServingFrontend(
+        engine, access_log_dir=os.path.join(run_dir, "logs")
+    )
 
 
 def main(argv=None) -> int:

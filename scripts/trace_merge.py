@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""Merge per-process Chrome traces + access logs + fleet events into ONE
+Perfetto timeline.
+
+A ``fleet_run`` (or a train + serve pair) leaves one ``logs/trace.json`` per
+process, each with its own wall-clock anchor (``otherData.epoch_unix``) and
+real pid, plus per-request ``logs/access.jsonl`` lines and the scheduler's
+``fleet_events.jsonl``. Debugging a cross-process request (or a fleet-wide
+stall) means eyeballing them TOGETHER — so this tool aligns every input onto
+one wall-clock zero, keeps each process on its own pid track (re-assigning
+only on collision or the legacy ``pid: 0``), renders access-log lines as
+complete events on a per-process ``access_log`` track (args carry the trace
+id — searchable in the Perfetto UI), renders fleet events as instants on a
+``fleet`` track, and validates the result with the repo's own
+``validate_chrome_trace`` before writing it.
+
+Usage::
+
+    python scripts/trace_merge.py --out merged.json A/logs/trace.json B/logs/trace.json
+    python scripts/trace_merge.py --root exps/<fleet-dir> --out merged.json
+
+``--root`` discovers every ``*/logs/trace*.json`` + sibling ``access.jsonl``
+under the root (any directory of runs: a fleet exps root, or a parent
+holding a train run and a serving run) and a root-level
+``fleet_events.jsonl`` when present. Prints ONE JSON summary line on stdout;
+rc 0 ok, 1 when the merged trace fails validation, 2 usage.
+
+Import-light by design (stdlib + the file-path-loaded trace module; no jax):
+merging finished runs must never touch a backend.
+"""
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO_ROOT, "howtotrainyourmamlpytorch_tpu")
+
+
+def _load_by_path(name: str, path: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+try:
+    _exit_codes = _load_by_path("htymp_exit_codes", os.path.join(_PKG, "exit_codes.py"))
+    _RC_OK, _RC_USAGE = _exit_codes.OK, _exit_codes.USAGE
+except Exception:  # standalone copy of scripts/: the historical literals hold
+    _RC_OK, _RC_USAGE = 0, 2
+#: merged trace failed validation — the lint.py "findings" convention
+_RC_INVALID = 1
+
+#: synthetic tid for the per-process access-log track (far above the span
+#: exporter's dense 0..n thread ids)
+ACCESS_TID = 9999
+
+
+def _read_jsonl(path: str) -> Tuple[List[Dict[str, Any]], int]:
+    records, torn = [], 0
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                torn += 1
+    return records, torn
+
+
+class _PidAllocator:
+    """Keep each input's real pid when possible; remap on collision (two
+    traces from recycled pids) or the legacy ``pid: 0`` export."""
+
+    def __init__(self):
+        self._used = set()
+        self._next_synthetic = 1_000_000
+
+    def assign(self, wanted: Optional[int]) -> int:
+        if wanted and wanted > 0 and wanted not in self._used:
+            self._used.add(wanted)
+            return wanted
+        pid = self._next_synthetic
+        while pid in self._used:
+            pid += 1
+        self._next_synthetic = pid + 1
+        self._used.add(pid)
+        return pid
+
+
+def _trace_pid(trace: Dict[str, Any]) -> Optional[int]:
+    pid = (trace.get("otherData") or {}).get("pid")
+    if isinstance(pid, int):
+        return pid
+    for ev in trace.get("traceEvents", []):
+        if isinstance(ev, dict) and isinstance(ev.get("pid"), int):
+            return ev["pid"]
+    return None
+
+
+def _label_for(path: str) -> str:
+    """Process label: the run-dir name (trace lives in <run>/logs/) or the
+    file's own stem for loose inputs."""
+    parent = os.path.dirname(os.path.abspath(path))
+    if os.path.basename(parent) == "logs":
+        return os.path.basename(os.path.dirname(parent))
+    return os.path.splitext(os.path.basename(path))[0]
+
+
+def merge(
+    trace_paths: List[str],
+    access_paths: Optional[List[str]] = None,
+    fleet_events_path: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Build the merged Chrome-trace object (no I/O besides reads).
+
+    Alignment: every input with an ``epoch_unix`` anchor is shifted onto the
+    EARLIEST anchor across inputs; anchor-less traces (or wall-stamped
+    records with no trace sibling) stay at their own zero — visibly
+    unaligned beats silently wrong."""
+    inputs: List[Dict[str, Any]] = []
+    for path in trace_paths:
+        with open(path) as f:
+            trace = json.load(f)
+        inputs.append({"path": path, "trace": trace,
+                       "epoch_unix": (trace.get("otherData") or {}).get("epoch_unix")})
+    anchors = [i["epoch_unix"] for i in inputs if isinstance(i["epoch_unix"], (int, float))]
+    base = min(anchors) if anchors else None
+
+    pids = _PidAllocator()
+    events: List[Dict[str, Any]] = []
+    dropped_spans = 0
+    open_spans = 0
+    label_to_pid: Dict[str, int] = {}
+    for item in inputs:
+        trace = item["trace"]
+        label = _label_for(item["path"])
+        pid = pids.assign(_trace_pid(trace))
+        label_to_pid[label] = pid
+        shift_us = 0.0
+        if base is not None and isinstance(item["epoch_unix"], (int, float)):
+            shift_us = (item["epoch_unix"] - base) * 1e6
+        for ev in trace.get("traceEvents", []):
+            if not isinstance(ev, dict):
+                continue
+            out = dict(ev)
+            out["pid"] = pid
+            if isinstance(out.get("ts"), (int, float)):
+                out["ts"] = round(out["ts"] + shift_us, 3)
+            events.append(out)
+        events.append(
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": label}}
+        )
+        other = trace.get("otherData") or {}
+        dropped_spans += int(other.get("dropped_spans", 0) or 0)
+        open_spans += int(other.get("open_spans", 0) or 0)
+
+    access_lines = 0
+    for path in access_paths or []:
+        label = _label_for(path)
+        pid = label_to_pid.get(label)
+        if pid is None:
+            pid = pids.assign(None)
+            label_to_pid[label] = pid
+            events.append(
+                {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                 "args": {"name": label}}
+            )
+        records, _ = _read_jsonl(path)
+        for rec in records:
+            ts_wall = rec.get("ts")
+            if base is None or not isinstance(ts_wall, (int, float)):
+                continue
+            total_ms = rec.get("total_ms") or 0.0
+            # access ts stamps request COMPLETION; draw the slice over the
+            # request's actual window so it overlaps its span chain
+            events.append(
+                {
+                    "name": f"{rec.get('verb')} {rec.get('outcome')}",
+                    "cat": "access",
+                    "ph": "X",
+                    # clamp: a request begun before the earliest trace
+                    # anchor must not export a (schema-invalid) negative ts
+                    "ts": max(
+                        0.0,
+                        round(((ts_wall - base) * 1e6) - total_ms * 1e3, 3),
+                    ),
+                    "dur": round(total_ms * 1e3, 3),
+                    "pid": pid,
+                    "tid": ACCESS_TID,
+                    "args": {
+                        k: v
+                        for k, v in rec.items()
+                        if isinstance(v, (int, float, bool, str, type(None)))
+                    },
+                }
+            )
+            access_lines += 1
+        events.append(
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": ACCESS_TID,
+             "args": {"name": "access_log"}}
+        )
+
+    fleet_events = 0
+    if fleet_events_path and os.path.exists(fleet_events_path):
+        pid = pids.assign(None)
+        events.append(
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": "fleet"}}
+        )
+        records, _ = _read_jsonl(fleet_events_path)
+        for rec in records:
+            ts_wall = rec.get("ts")
+            if base is None or not isinstance(ts_wall, (int, float)):
+                continue
+            events.append(
+                {
+                    "name": str(rec.get("event", "fleet_event")),
+                    "cat": "fleet",
+                    "ph": "i",
+                    "s": "g",  # global-scope instant: a fleet-wide mark
+                    "ts": round((ts_wall - base) * 1e6, 3),
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {
+                        k: v
+                        for k, v in rec.items()
+                        if isinstance(v, (int, float, bool, str, type(None)))
+                    },
+                }
+            )
+            fleet_events += 1
+
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": [i["path"] for i in inputs],
+            "epoch_unix": base,
+            "open_spans": open_spans,
+            "dropped_spans": dropped_spans,
+            "access_lines": access_lines,
+            "fleet_events": fleet_events,
+        },
+    }
+
+
+def discover(root: str) -> Tuple[List[str], List[str], Optional[str]]:
+    """``--root`` inputs: every ``*/logs/trace*.json`` (one level of run
+    dirs, archived sessions included) + sibling ``access.jsonl`` files +
+    the root-level ``fleet_events.jsonl`` when the scheduler wrote one."""
+    traces = sorted(glob.glob(os.path.join(root, "*", "logs", "trace*.json")))
+    access = sorted(glob.glob(os.path.join(root, "*", "logs", "access.jsonl")))
+    fleet = os.path.join(root, "fleet_events.jsonl")
+    return traces, access, fleet if os.path.exists(fleet) else None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("traces", nargs="*", help="per-process trace*.json files")
+    parser.add_argument("--root", default=None,
+                        help="discover */logs/trace*.json + access.jsonl + "
+                        "fleet_events.jsonl under this directory")
+    parser.add_argument("--out", required=True, help="merged trace output path")
+    parser.add_argument("--access", action="append", default=[],
+                        help="access.jsonl file(s) to add as request tracks")
+    parser.add_argument("--fleet-events", default=None,
+                        help="fleet_events.jsonl to add as an instant track")
+    args = parser.parse_args(argv)
+
+    traces = list(args.traces)
+    access = list(args.access)
+    fleet = args.fleet_events
+    if args.root:
+        found_traces, found_access, found_fleet = discover(args.root)
+        traces += found_traces
+        access += found_access
+        fleet = fleet or found_fleet
+    if not traces:
+        print("trace_merge: no input traces (pass files or --root)", file=sys.stderr)
+        return _RC_USAGE
+
+    try:
+        merged = merge(traces, access_paths=access, fleet_events_path=fleet)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"trace_merge: unreadable input: {exc}", file=sys.stderr)
+        return _RC_USAGE
+
+    trace_mod = _load_by_path(
+        "htymp_trace", os.path.join(_PKG, "observability", "trace.py")
+    )
+    violations = trace_mod.validate_chrome_trace(merged)
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    print(
+        json.dumps(
+            {
+                "out": args.out,
+                "traces": len(traces),
+                "access_files": len(access),
+                "events": len(merged["traceEvents"]),
+                "access_lines": merged["otherData"]["access_lines"],
+                "fleet_events": merged["otherData"]["fleet_events"],
+                "violations": violations,
+                "ok": not violations,
+            }
+        ),
+        flush=True,
+    )
+    return _RC_OK if not violations else _RC_INVALID
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -782,6 +782,32 @@ def test_self_gate_covers_precision_paths_explicitly():
     )
 
 
+def test_self_gate_covers_request_tracing_paths_explicitly():
+    """The request-scoped tracing layer (ISSUE 10) sits inside the
+    self-gate on its own terms: context.py runs inside HTTP handler threads
+    and the batcher worker (GL201 territory, and its id minting must stay
+    os.urandom — GL120/121 territory), and both new CLIs are exit-code
+    consumers (GL301 territory) — zero unsuppressed findings even if the
+    top-level path list is ever restructured."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "observability", "context.py"
+                ),
+                os.path.join("scripts", "trace_merge.py"),
+                os.path.join("scripts", "obs_top.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in request-tracing paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_catches_an_introduced_true_positive(tmp_path):
     """End-to-end: drop one fixture true positive next to real package code
     and the CLI must exit 1 with a GL id on stdout."""

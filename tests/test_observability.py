@@ -127,7 +127,9 @@ def test_chrome_trace_schema_valid_and_balanced(tmp_path):
     (event,) = [e for e in trace["traceEvents"] if e["ph"] == "X"]
     assert event["dur"] == pytest.approx(0.5e6)  # microseconds
     assert event["args"]["bucket"] == "(25, 8)"
-    assert isinstance(event["tid"], int) and event["pid"] == 0
+    # the REAL pid, not the old hardcoded 0 — trace_merge keys process
+    # tracks off it
+    assert isinstance(event["tid"], int) and event["pid"] == os.getpid()
 
 
 def test_chrome_trace_validator_rejects_malformed():

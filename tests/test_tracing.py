@@ -1,0 +1,703 @@
+"""Request-scoped tracing (ISSUE 10): traceparent plumbing, flow-linked
+span chains across threads, the structured access log, the Prometheus
+exposition, trace_merge over a 2-process toy fleet run, and the obs_top
+console contract."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import Config, ObservabilityConfig, ServingConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+from howtotrainyourmamlpytorch_tpu.observability import slo
+from howtotrainyourmamlpytorch_tpu.observability.context import (
+    AccessLog,
+    RequestContext,
+    format_traceparent,
+    new_request_context,
+    parse_traceparent,
+)
+from howtotrainyourmamlpytorch_tpu.observability.metrics import (
+    MetricsRegistry,
+    prometheus_text,
+)
+from howtotrainyourmamlpytorch_tpu.observability.trace import (
+    SpanTracer,
+    load_and_validate_trace,
+    validate_chrome_trace,
+)
+from howtotrainyourmamlpytorch_tpu.serving import (
+    AdaptationEngine,
+    ServingFrontend,
+    make_http_server,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_IMG = (28, 28, 1)
+
+
+# ---------------------------------------------------------------------------
+# context: traceparent + minting
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_round_trip_and_minting():
+    ctx = new_request_context()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    assert ctx.parent_id is None and ctx.sampled
+
+    # a downstream hop adopts our trace id and parents on our span id
+    header = format_traceparent(ctx)
+    child = parse_traceparent(header)
+    assert child.trace_id == ctx.trace_id
+    assert child.parent_id == ctx.span_id
+    assert child.span_id != ctx.span_id  # each hop mints its own
+    assert child.sampled
+
+    unsampled = parse_traceparent(f"00-{'a' * 32}-{'b' * 16}-00")
+    assert unsampled.sampled is False and unsampled.trace_id == "a" * 32
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        "00-short-deadbeef-01",
+        f"00-{'0' * 32}-{'b' * 16}-01",  # all-zero trace id is invalid
+        f"ff-{'a' * 32}-{'b' * 16}-01",  # unknown version
+    ],
+)
+def test_bad_traceparent_mints_fresh(header):
+    ctx = parse_traceparent(header)
+    assert ctx.parent_id is None and len(ctx.trace_id) == 32
+
+
+def test_access_log_sampling_deterministic_and_failure_bypass(tmp_path):
+    log = AccessLog(str(tmp_path), sample=0.5, wall_clock=lambda: 123.0)
+    # deterministic on the id: leading bits decide, identically everywhere
+    low = RequestContext(trace_id="00000000" + "0" * 23 + "1", span_id="a" * 16)
+    high = RequestContext(trace_id="ffffffff" + "0" * 24, span_id="a" * 16)
+    assert log.record(low, "adapt", "ok", 200, 0.01)
+    assert not log.record(high, "adapt", "ok", 200, 0.01)
+    # ... but a FAILURE on the sampled-out id is always logged
+    assert log.record(high, "adapt", "shed", 503, 0.01)
+    stats = log.stats()
+    assert stats["lines"] == 2 and stats["sampled_out"] == 1
+    lines = [json.loads(l) for l in open(log.path)]
+    assert [l["outcome"] for l in lines] == ["ok", "shed"]
+    assert lines[1]["trace_id"] == high.trace_id and lines[1]["status"] == 503
+    log.close()
+
+
+# ---------------------------------------------------------------------------
+# tracer: flow events + real pid + validator pairing
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_flow_events_exported_and_validated(tmp_path):
+    clock = FakeClock()
+    tracer = SpanTracer(capacity=16, clock=clock, wall_clock=lambda: 1000.0)
+    tid_a, tid_b = "a" * 32, "b" * 32
+    # two requests, one flush (the batched case): two s, one flush span
+    # carrying two t flows, one dispatch span carrying two f flows
+    with tracer.span("serve.flush", flows=[(tid_a, "t"), (tid_b, "t")]):
+        clock.advance(0.1)
+        with tracer.span("dispatch", flows=[(tid_a, "f"), (tid_b, "f")]):
+            clock.advance(0.2)
+    with tracer.span("serve.adapt", flows=[(tid_a, "s")]):
+        clock.advance(0.05)
+    with tracer.span("serve.adapt", flows=[(tid_b, "s")]):
+        clock.advance(0.05)
+    trace = tracer.to_chrome_trace()
+    assert validate_chrome_trace(trace) == []
+    flows = [e for e in trace["traceEvents"] if e["ph"] in ("s", "t", "f")]
+    by_role = {}
+    for e in flows:
+        assert e["name"] == "request" and e["cat"] == "request"
+        by_role.setdefault(e["ph"], set()).add(e["id"])
+    assert by_role == {"s": {tid_a, tid_b}, "t": {tid_a, tid_b}, "f": {tid_a, tid_b}}
+    # binding: t/f anchor to their ENCLOSING slice
+    assert all("bp" in e for e in flows if e["ph"] in ("t", "f"))
+    # real pid on every event + the merge anchor in otherData
+    assert all(e["pid"] == os.getpid() for e in trace["traceEvents"])
+    assert trace["otherData"]["epoch_unix"] == 1000.0
+    path = str(tmp_path / "t.json")
+    tracer.export(path)
+    assert load_and_validate_trace(path) == []
+
+
+def test_validator_flow_pairing():
+    def tr(events):
+        return {"traceEvents": events}
+
+    # a finish whose flow never started is the torn-arc signature
+    bad = tr([{"name": "request", "cat": "request", "ph": "f", "id": "x",
+               "ts": 0, "pid": 1, "tid": 0, "bp": "e"}])
+    assert any("no start" in p for p in validate_chrome_trace(bad))
+    # id-less flow events are unbindable
+    bad = tr([{"name": "request", "cat": "request", "ph": "s",
+               "ts": 0, "pid": 1, "tid": 0}])
+    assert any("without an id" in p for p in validate_chrome_trace(bad))
+    # a start with no finish is NOT a violation: that is what a cache hit /
+    # shed request legitimately looks like
+    ok = tr([{"name": "request", "cat": "request", "ph": "s", "id": "x",
+              "ts": 0, "pid": 1, "tid": 0}])
+    assert validate_chrome_trace(ok) == []
+    # order-independence: the ring orders by span completion, so f-then-s
+    # within one export is the NORMAL nesting order
+    ok = tr([
+        {"name": "request", "cat": "request", "ph": "f", "id": "y",
+         "ts": 5, "pid": 1, "tid": 0, "bp": "e"},
+        {"name": "request", "cat": "request", "ph": "s", "id": "y",
+         "ts": 0, "pid": 1, "tid": 1},
+    ])
+    assert validate_chrome_trace(ok) == []
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_text_schema_pin():
+    reg = MetricsRegistry()
+    reg.inc("serving.events.shed", 3)
+    reg.set_gauge("flops_per_step", 1.5e9)
+    reg.set_gauge("breaker_state", "open")  # non-numeric: JSON-only
+    for v in (0.01, 0.02, 0.03):
+        reg.observe("phase.settle", v)
+    text = prometheus_text(reg)
+    lines = text.splitlines()
+    assert "# TYPE htymp_serving_events_shed_total counter" in lines
+    assert "htymp_serving_events_shed_total 3" in lines
+    assert "# TYPE htymp_flops_per_step gauge" in lines
+    assert "htymp_flops_per_step 1500000000.0" in lines
+    assert "# TYPE htymp_phase_settle summary" in lines
+    assert 'htymp_phase_settle{quantile="0.5"} 0.02' in lines
+    assert "htymp_phase_settle_count 3" in lines
+    assert any(l.startswith("htymp_phase_settle_sum ") for l in lines)
+    assert not any("breaker_state" in l for l in lines)
+    # every sample line is exposition-format: name{labels}? value
+    import re
+
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.eE]+$"
+    )
+    for line in lines:
+        if line.startswith("#"):
+            continue
+        assert sample.match(line), line
+
+
+# ---------------------------------------------------------------------------
+# serving e2e: HTTP -> access log -> flow-linked trace
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg(**obs_kwargs):
+    return Config(
+        num_classes_per_set=5,
+        num_samples_per_class=2,
+        num_target_samples=3,
+        batch_size=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        serving=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=4,
+            batch_deadline_ms=30.0,
+        ),
+        observability=ObservabilityConfig(**obs_kwargs),
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_system_state():
+    cfg = _tiny_cfg()
+    system = MAMLSystem(
+        cfg, model=build_vgg(_IMG, 5, num_stages=2, cnn_num_filters=4)
+    )
+    return cfg, system, system.init_train_state()
+
+
+def _episode(seed):
+    b = synthetic_batch(1, 5, 2, 3, _IMG, seed=seed)
+    return (
+        b["x_support"][0],
+        b["y_support"][0],
+        b["x_target"][0].reshape((-1,) + _IMG),
+    )
+
+
+def test_http_request_to_access_line_to_flow_trace(tmp_path, tiny_system_state):
+    """THE acceptance chain: one HTTP request -> an access.jsonl line whose
+    trace id appears as a linked flow (s at the HTTP span, t at the flush,
+    f at the engine dispatch) in the exported trace, with the timing
+    breakdown in the response body and the id echoed in X-Request-Id."""
+    cfg, system, state = tiny_system_state
+    frontend = ServingFrontend(
+        AdaptationEngine(system, state), access_log_dir=str(tmp_path)
+    )
+    server = make_http_server(frontend, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    upstream = new_request_context()
+    try:
+        x_s, y_s, x_q = _episode(5)
+        req = urllib.request.Request(
+            base + "/adapt",
+            data=json.dumps(
+                {"x_support": x_s.tolist(), "y_support": y_s.tolist()}
+            ).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "traceparent": format_traceparent(upstream),
+            },
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+            rid = resp.headers["X-Request-Id"]
+            echoed = resp.headers["traceparent"]
+        # the caller's trace id is adopted, echoed, and parented
+        assert rid == upstream.trace_id
+        assert out["trace_id"] == upstream.trace_id
+        assert echoed.split("-")[1] == upstream.trace_id
+        timing = out["timing"]
+        assert timing["total_ms"] > 0
+        assert timing["queue_wait_ms"] is not None
+        assert timing["dispatch_ms"] is not None
+        # predict rides a fresh server-minted id
+        req2 = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps(
+                {"adaptation_id": out["adaptation_id"], "x_query": x_q.tolist()}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req2, timeout=120) as resp:
+            out2 = json.loads(resp.read())
+            rid2 = resp.headers["X-Request-Id"]
+        assert out2["trace_id"] == rid2 and rid2 != rid
+        assert out2["timing"]["total_ms"] > 0
+
+        # access.jsonl: one line per request, fields per the runbook table
+        lines = [json.loads(l) for l in open(os.path.join(str(tmp_path), "access.jsonl"))]
+        by_id = {l["trace_id"]: l for l in lines}
+        assert set(by_id) == {rid, rid2}
+        adapt_line = by_id[rid]
+        assert adapt_line["verb"] == "adapt" and adapt_line["outcome"] == "ok"
+        assert adapt_line["status"] == 200
+        assert adapt_line["parent_id"] == upstream.span_id
+        assert adapt_line["bucket"] == 16
+        assert adapt_line["flush_batch"] == 1
+        assert adapt_line["cache_hit"] is False
+        assert adapt_line["queue_wait_ms"] is not None
+        assert adapt_line["dispatch_ms"] is not None
+        assert adapt_line["breaker"] == "closed"
+
+        # the exported trace links the journey: s (HTTP span) -> t (flush)
+        # -> f (dispatch) for BOTH request ids, and validates
+        trace = frontend.hub.tracer.to_chrome_trace()
+        assert validate_chrome_trace(trace) == []
+        roles = {}
+        for e in trace["traceEvents"]:
+            if e["ph"] in ("s", "t", "f"):
+                roles.setdefault(e["id"], set()).add(e["ph"])
+        assert roles[rid] == {"s", "t", "f"}
+        assert roles[rid2] == {"s", "t", "f"}
+        # /metrics surfaces the access log and the prom exposition parses
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+            metrics = json.loads(resp.read())
+        assert metrics["access_log"]["lines"] == 2
+        with urllib.request.urlopen(base + "/metrics?format=prom", timeout=30) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            prom = resp.read().decode()
+        assert "htymp_serving_latency_adapt_count 1" in prom.splitlines()
+    finally:
+        server.shutdown()
+        server.server_close()
+        frontend.close()
+        thread.join(timeout=5)
+
+
+def test_batched_flush_two_requests_one_flush_two_flows(tiny_system_state, tmp_path):
+    """Two concurrent same-bucket predicts coalesce into ONE flush span
+    that carries BOTH trace flows — the continuous-batching attribution:
+    each access line records flush_batch=2 and the same dispatch cost."""
+    cfg, system, state = tiny_system_state
+    frontend = ServingFrontend(
+        AdaptationEngine(system, state), access_log_dir=str(tmp_path)
+    )
+    try:
+        x_s, y_s, x_q = _episode(7)
+        info = frontend.adapt(x_s, y_s)
+        frontend.predict(info["adaptation_id"], x_q)  # warm the program
+
+        ctxs = [new_request_context(), new_request_context()]
+        barrier = threading.Barrier(2)
+
+        def hit(ctx):
+            barrier.wait(5.0)
+            frontend.predict(info["adaptation_id"], x_q, ctx=ctx)
+
+        threads = [threading.Thread(target=hit, args=(c,)) for c in ctxs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+
+        assert {c.flush_batch for c in ctxs} == {2}
+        assert all(c.queue_wait_s is not None for c in ctxs)
+        assert ctxs[0].dispatch_s == ctxs[1].dispatch_s  # one shared dispatch
+        recs = frontend.hub.tracer.records()
+        both = {c.trace_id for c in ctxs}
+        flush_flows = [
+            set(fid for fid, role in (r["flows"] or ()))
+            for r in recs
+            if r["name"] == "serve.flush.predict" and r["flows"]
+        ]
+        assert both in flush_flows  # ONE flush span carries both flows
+        lines = [json.loads(l) for l in open(os.path.join(str(tmp_path), "access.jsonl"))]
+        batched = [l for l in lines if l["trace_id"] in both]
+        assert len(batched) == 2
+        assert all(l["flush_batch"] == 2 for l in batched)
+    finally:
+        frontend.close()
+
+
+def test_disabled_observability_is_zero_file_and_header_free(tmp_path, tiny_system_state):
+    """Observability off: no access.jsonl, no trace ids minted, no
+    X-Request-Id / timing keys on the wire — the request path is
+    bit-identical to the un-instrumented build."""
+    _, system, state = tiny_system_state
+    cfg = _tiny_cfg(enabled=False)
+    system_off = MAMLSystem(
+        cfg, model=build_vgg(_IMG, 5, num_stages=2, cnn_num_filters=4)
+    )
+    off_dir = str(tmp_path / "off")
+    frontend = ServingFrontend(
+        AdaptationEngine(system_off, state), access_log_dir=off_dir
+    )
+    server = make_http_server(frontend, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        x_s, y_s, x_q = _episode(9)
+        req = urllib.request.Request(
+            base + "/adapt",
+            data=json.dumps(
+                {"x_support": x_s.tolist(), "y_support": y_s.tolist()}
+            ).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": f"00-{'a' * 32}-{'b' * 16}-01"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+            assert resp.headers.get("X-Request-Id") is None
+        assert "trace_id" not in out and "timing" not in out
+        assert frontend.access_log is None
+        assert not os.path.exists(off_dir)
+        assert frontend.hub.tracer.records() == []
+    finally:
+        server.shutdown()
+        server.server_close()
+        frontend.close()
+        thread.join(timeout=5)
+
+
+def test_access_log_disabled_by_config_knob(tmp_path, tiny_system_state):
+    """observability.access_log=false keeps tracing but writes no file."""
+    _, _, state = tiny_system_state
+    cfg = _tiny_cfg(access_log=False)
+    system = MAMLSystem(
+        cfg, model=build_vgg(_IMG, 5, num_stages=2, cnn_num_filters=4)
+    )
+    log_dir = str(tmp_path / "noaccess")
+    frontend = ServingFrontend(
+        AdaptationEngine(system, state), access_log_dir=log_dir
+    )
+    try:
+        x_s, y_s, _ = _episode(11)
+        out = frontend.adapt(x_s, y_s)
+        assert "trace_id" in out  # tracing still on
+        assert frontend.access_log is None
+        assert not os.path.exists(log_dir)
+    finally:
+        frontend.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO report: failing stairs name their worst request ids
+# ---------------------------------------------------------------------------
+
+
+def test_slo_report_failing_stair_names_worst_ids(tmp_path):
+    schedule = [
+        slo.Request(t=0.1 * i, kind="predict", episode_seed=i, n_query=5,
+                    stair=i // 4)
+        for i in range(8)
+    ]
+    rows = [
+        {"stair": 0, "kind": "predict", "outcome": "ok",
+         "latency_ms": 10.0 + i, "trace_id": f"fast{i:028x}"}
+        for i in range(4)
+    ] + [
+        {"stair": 1, "kind": "predict", "outcome": "ok" if i else "deadline",
+         "latency_ms": 5000.0 - i * 1000, "trace_id": f"slow{i:028x}"}
+        for i in range(4)
+    ]
+    access_path = str(tmp_path / "access.jsonl")
+    with open(access_path, "w") as f:
+        f.write(json.dumps({
+            "trace_id": "slow" + "0" * 28, "queue_wait_ms": 4900.0,
+            "dispatch_ms": 50.0, "flush_batch": 3, "bucket": 16,
+        }) + "\n")
+    report = slo.slo_report(
+        schedule,
+        {"rows": rows, "breaker_trips": 0, "wall_s": 1.0},
+        stairs_rps=[4, 8],
+        duration_s=2.0,
+        seed=0,
+        slo_p99_ms=100.0,
+        max_shed_rate=0.05,
+        worst_k=2,
+        access_log_path=access_path,
+    )
+    s0, s1 = report["stairs"]
+    assert s0["slo_met"] and "worst_requests" not in s0
+    assert not s1["slo_met"]
+    worst = s1["worst_requests"]
+    assert len(worst) == 2
+    # ranked by latency; the deadline miss leads and joins its access line
+    assert worst[0]["trace_id"] == "slow" + "0" * 28
+    assert worst[0]["outcome"] == "deadline"
+    assert worst[0]["queue_wait_ms"] == 4900.0 and worst[0]["flush_batch"] == 3
+    assert report["access_log"]["lines"] == 1
+
+
+def test_run_load_mints_trace_ids_and_drives_ctx_frontends():
+    """run_load stamps a loadgen-minted trace id on every outcome row, and
+    still drives ctx-less frontend doubles (the back-compat seam)."""
+
+    class _Breaker:
+        def snapshot(self):
+            return {"opens": 0}
+
+    class Plain:  # no ctx parameter anywhere
+        breaker = _Breaker()
+
+        def adapt(self, x, y):
+            return {"adaptation_id": "a"}
+
+        def predict(self, aid, xq):
+            return np.zeros((1, 5))
+
+    schedule = [
+        slo.Request(t=0.0, kind="adapt", episode_seed=1, n_query=5, stair=0),
+        slo.Request(t=0.01, kind="predict", episode_seed=2, n_query=5, stair=0),
+    ]
+    run = slo.run_load(
+        Plain(), schedule, lambda s: (None, None), lambda s, n: None,
+        warm_adaptations=1, result_grace_s=5.0,
+    )
+    assert len(run["rows"]) == 2
+    assert all(len(r["trace_id"]) == 32 for r in run["rows"])
+    assert len({r["trace_id"] for r in run["rows"]}) == 2
+
+
+# ---------------------------------------------------------------------------
+# trace_merge: 2-process toy fleet run -> one validated Perfetto file
+# ---------------------------------------------------------------------------
+
+_CHILD_SCRIPT = r"""
+import importlib.util, json, os, sys, time
+repo, run_dir, trace_id, t_base = sys.argv[1], sys.argv[2], sys.argv[3], float(sys.argv[4])
+spec = importlib.util.spec_from_file_location(
+    "t", os.path.join(repo, "howtotrainyourmamlpytorch_tpu", "observability", "trace.py"))
+trace = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(trace)
+clock = [0.0]
+tracer = trace.SpanTracer(capacity=64, clock=lambda: clock[0], wall_clock=lambda: t_base)
+with tracer.span("serve.adapt", flows=[(trace_id, "s")], trace=trace_id):
+    clock[0] += 0.01
+    with tracer.span("serve.flush.adapt", flows=[(trace_id, "t")]):
+        clock[0] += 0.02
+        with tracer.span("serve.adapt_dispatch", flows=[(trace_id, "f")]):
+            clock[0] += 0.03
+logs = os.path.join(run_dir, "logs")
+os.makedirs(logs, exist_ok=True)
+tracer.export(os.path.join(logs, "trace.json"))
+with open(os.path.join(logs, "access.jsonl"), "w") as f:
+    f.write(json.dumps({"ts": t_base + 0.06, "trace_id": trace_id, "verb": "adapt",
+                        "outcome": "ok", "status": 200, "total_ms": 60.0}) + "\n")
+print(os.getpid())
+"""
+
+
+def test_trace_merge_round_trip_two_process_toy_fleet(tmp_path):
+    """Two real processes (distinct pids) each export a flow-linked trace +
+    access log; a fleet_events.jsonl rides along. trace_merge emits ONE
+    file that load_and_validate_trace accepts, with each process on its
+    own real-pid track, both flows intact, and access/fleet rows as
+    events."""
+    root = tmp_path / "fleet"
+    ids = ["c" * 32, "d" * 32]
+    pids = []
+    for i, tid in enumerate(ids):
+        run_dir = root / f"cell{i}"
+        run_dir.mkdir(parents=True)
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD_SCRIPT, REPO_ROOT, str(run_dir),
+             tid, str(1000.0 + i)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        pids.append(int(proc.stdout.strip()))
+    assert pids[0] != pids[1]
+    with open(root / "fleet_events.jsonl", "w") as f:
+        f.write(json.dumps({"ts": 1000.5, "event": "cell_launch", "cell": "cell0"}) + "\n")
+        f.write(json.dumps({"ts": 1001.5, "event": "cell_done", "cell": "cell1", "rc": 0}) + "\n")
+
+    out = str(tmp_path / "merged.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "trace_merge.py"),
+         "--root", str(root), "--out", out],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    summary = json.loads(proc.stdout)
+    assert summary["ok"] and summary["traces"] == 2
+    assert summary["access_lines"] == 2 and summary["fleet_events"] == 2
+
+    assert load_and_validate_trace(out) == []
+    with open(out) as f:
+        merged = json.load(f)
+    events = merged["traceEvents"]
+    # each child keeps its REAL pid track, named after its run dir
+    x_pids = {e["pid"] for e in events if e["ph"] == "X" and e.get("cat") == "host"}
+    assert x_pids == set(pids)
+    names = {
+        e["args"]["name"]
+        for e in events
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert {"cell0", "cell1", "fleet"} <= names
+    # both flows survive the merge, one s/t/f arc each
+    roles = {}
+    for e in events:
+        if e["ph"] in ("s", "t", "f"):
+            roles.setdefault(e["id"], set()).add(e["ph"])
+    assert roles == {ids[0]: {"s", "t", "f"}, ids[1]: {"s", "t", "f"}}
+    # wall-clock alignment: cell1's anchor is 1s after cell0's
+    cell1_events = [e for e in events if e.get("pid") == pids[1] and e["ph"] == "X"
+                    and e.get("cat") == "host"]
+    assert min(e["ts"] for e in cell1_events) >= 1e6
+    # access lines render as searchable events carrying the trace id
+    access = [e for e in events if e.get("cat") == "access"]
+    assert {e["args"]["trace_id"] for e in access} == set(ids)
+    fleet = [e for e in events if e.get("cat") == "fleet"]
+    assert [e["name"] for e in fleet] == ["cell_launch", "cell_done"]
+
+
+# ---------------------------------------------------------------------------
+# obs_top: console frames over telemetry.jsonl and /metrics payloads
+# ---------------------------------------------------------------------------
+
+
+def _load_obs_top():
+    spec = importlib.util.spec_from_file_location(
+        "obs_top", os.path.join(REPO_ROOT, "scripts", "obs_top.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_obs_top_run_dir_frame_cli(tmp_path):
+    logs = tmp_path / "run" / "logs"
+    logs.mkdir(parents=True)
+    snapshot = {
+        "ts": 1.0, "kind": "epoch", "session": "s1", "elapsed_s": 10.0,
+        "steps": 20, "interval_episodes_per_s": 3.5, "mfu": 0.12,
+        "phases": {"settle": {"p50_ms": 40.0, "p95_ms": 60.0, "count": 20}},
+        "providers": {
+            "memory": {"headroom_frac_min": 0.42},
+            "watchdog": {"beat_age_s": 1.5},
+        },
+        "dropped_spans": 0,
+    }
+    with open(logs / "telemetry.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "step"}) + "\n")
+        f.write(json.dumps(snapshot) + "\n")
+        f.write('{"torn')  # hard-killed run: the console must not die
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "obs_top.py"),
+         "--run-dir", str(tmp_path / "run"), "--once", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    frame = json.loads(proc.stdout)
+    assert frame["source"] == "telemetry"
+    assert frame["mfu"] == 0.12
+    assert frame["episodes_per_s"] == 3.5
+    assert frame["hbm_headroom_frac"] == 0.42
+    assert frame["watchdog_beat_age_s"] == 1.5
+    assert frame["phases"]["settle"]["p50_ms"] == 40.0
+
+
+def test_obs_top_serving_frame_qps_and_render():
+    obs_top = _load_obs_top()
+    metrics = {
+        "uptime_s": 12.0,
+        "latency": {
+            "adapt": {"p50_ms": 30.0, "p99_ms": 90.0, "count": 10},
+            "predict": {"p50_ms": 5.0, "p99_ms": 20.0, "count": 30},
+        },
+        "adapt_batcher": {"queue_depth": 1},
+        "predict_batcher": {"queue_depth": 2},
+        "cache": {"hit_rate": 0.8},
+        "resilience": {"shed": 3, "deadline_exceeded": 1,
+                       "breaker": {"state": "closed", "opens": 0}},
+        "prewarm": {"status": "warm"},
+        "access_log": {"lines": 40},
+        "memory": {"headroom_frac_min": 0.3},
+    }
+    first = obs_top.serving_frame(metrics, None, 2.0)
+    assert first["qps"] is None and first["requests"] == 40
+    later = dict(metrics)
+    later["latency"] = {
+        "adapt": {"p50_ms": 30.0, "p99_ms": 90.0, "count": 14},
+        "predict": {"p50_ms": 5.0, "p99_ms": 20.0, "count": 46},
+    }
+    second = obs_top.serving_frame(later, first, 2.0)
+    assert second["qps"] == 10.0  # (60 - 40) / 2s
+    assert second["queue_depth"] == {"adapt": 1, "predict": 2}
+    assert second["breaker"] == "closed" and second["shed"] == 3
+    assert second["hbm_headroom_frac"] == 0.3
+    rendered = obs_top.render(second)
+    for token in ("qps 10", "breaker closed", "p99 90 ms", "hbm_headroom 0.3"):
+        assert token in rendered, (token, rendered)
