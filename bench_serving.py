@@ -210,6 +210,51 @@ def main(argv=None) -> int:
             best_batch = int(b)
             flops_per_query = p["flops"] / (int(b) * int(bucket))
     result["predict_flops_per_query"] = flops_per_query
+
+    # --- fleet staircase: sustained RPS within SLO through a replicated
+    # frontend (BENCH_REPLICAS engine replicas behind the affinity router,
+    # observability/slo.py open-loop schedule). BENCH_SLO_DURATION_S=0
+    # skips it; the fields stay in the line either way so captures join.
+    n_replicas = int(os.environ.get("BENCH_REPLICAS", "1"))
+    slo_duration = float(os.environ.get("BENCH_SLO_DURATION_S", "6"))
+    result["sustained_rps"] = None
+    from howtotrainyourmamlpytorch_tpu.observability import slo
+    from howtotrainyourmamlpytorch_tpu.serving.server import ServingFrontend
+
+    # the frontend resolves BENCH_REPLICAS=0 to the actual per-device
+    # count — the JSON line must carry the real denominator of the
+    # scaling headline, not the raw env value
+    frontend = ServingFrontend(engine, replicas=n_replicas)
+    try:
+        result["replicas"] = len(frontend.pool)
+        if slo_duration > 0:
+            stairs = [
+                float(s)
+                for s in os.environ.get("BENCH_SLO_STAIRS", "4,8").split(",")
+                if s.strip()
+            ]
+            schedule = slo.generate_schedule(
+                0, slo_duration, stairs,
+                adapt_frac=0.25, query_sizes=(args.n_query,), query_weights=(1.0,),
+            )
+            if schedule:
+                run = slo.run_load(
+                    frontend,
+                    schedule,
+                    lambda seed: episode(seed & 0x7FFFFFFF)[:2],
+                    lambda seed, n_q: episode(seed & 0x7FFFFFFF)[2][:n_q],
+                    log=lambda m: print(m, file=sys.stderr, flush=True),
+                )
+                slo_rep = slo.slo_report(
+                    schedule, run, stairs_rps=stairs, duration_s=slo_duration,
+                    seed=0, slo_p99_ms=2000.0, max_shed_rate=0.05,
+                )
+                result["sustained_rps"] = slo_rep["value"]
+                result["slo_breaker_trips"] = slo_rep["breaker_trips"]
+                if "per_replica" in slo_rep:
+                    result["per_replica"] = slo_rep["per_replica"]
+    finally:
+        frontend.close()
     device_kind = str(jax.devices()[0].device_kind)
     mfu_value, mfu_reason = obs_costs.mfu(
         flops_per_query, queries_per_sec, device_kind

@@ -460,7 +460,9 @@ def prewarm_serving(
                 _sds((b, bucket), np.int32),
                 _sds((b, bucket), np.float32),
             )
-            name = f"serve_adapt/{bucket}/{b}"
+            # the engine's ledger tag ("@r1" on fleet clones) keeps every
+            # replica's rows distinct in merged prewarm/ledger tables
+            name = f"serve_adapt{getattr(engine, 'ledger_tag', '')}/{bucket}/{b}"
         else:  # predict: per-item fast weights stacked on the task axis
             fn = engine._compiled_predict(bucket, b)
             if b not in fw_specs:
@@ -470,7 +472,7 @@ def prewarm_serving(
                 _sds((b, bucket, h, w, c), np.float32),
                 _sds((b, bucket), np.float32),
             )
-            name = f"serve_predict/{bucket}/{b}"
+            name = f"serve_predict{getattr(engine, 'ledger_tag', '')}/{bucket}/{b}"
         jobs.append((name, fn, args))
     return _run_warm_pool(
         jobs,
@@ -481,6 +483,52 @@ def prewarm_serving(
         on_program,
         store=store,
     )
+
+
+def prewarm_pool(pool, **kwargs) -> Dict[str, Any]:
+    """Per-replica warm gating for a serving fleet
+    (``serving/pool.py::EnginePool``): every DISTINCT engine behind the
+    pool is warmed through its own :meth:`AdaptationEngine.prewarm` —
+    manifest-gated executable-store loads and all — exactly once;
+    same-device replicas sharing an engine share its warm set for free.
+    Returns the single-engine summary schema (totals summed, seconds are
+    the wall cost actually paid) plus a per-replica table mapping each
+    replica to the warm verdict of the engine it serves through."""
+    engines = pool.engines()
+    summaries: List[Dict[str, Any]] = []
+    for engine in engines:
+        summaries.append(engine.prewarm(**kwargs))
+    merged: Dict[str, Any] = {
+        "programs": sum(s.get("programs", 0) for s in summaries),
+        "seconds": round(sum(s.get("seconds", 0.0) for s in summaries), 3),
+        "cache_hits": sum(s.get("cache_hits", 0) for s in summaries),
+        "store_hits": sum(s.get("store_hits", 0) for s in summaries),
+        "errors": sum(s.get("errors", 0) for s in summaries),
+        "by_program": {
+            k: v for s in summaries for k, v in s.get("by_program", {}).items()
+        },
+    }
+    per_replica = []
+    for replica in pool.replicas:
+        engine_idx = next(
+            i for i, e in enumerate(engines) if e is replica.engine
+        )
+        s = summaries[engine_idx]
+        per_replica.append(
+            {
+                "replica": replica.index,
+                "engine": engine_idx,
+                "shared": sum(
+                    1 for r in pool.replicas if r.engine is replica.engine
+                )
+                > 1,
+                "programs": s.get("programs", 0),
+                "seconds": s.get("seconds", 0.0),
+                "errors": s.get("errors", 0),
+            }
+        )
+    merged["replicas"] = per_replica
+    return merged
 
 
 # ---------------------------------------------------------------------------

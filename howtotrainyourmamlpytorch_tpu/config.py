@@ -111,6 +111,22 @@ class ServingConfig:
     # power of two <= max_batch_size so batch sizes also reuse compiles.
     max_batch_size: int = 8
     batch_deadline_ms: float = 3.0
+    # Continuous batching (serving/batcher.py): requests arriving while a
+    # flush is in flight join the NEXT flush the moment the worker frees,
+    # instead of waiting out their own deadline window — under load the
+    # batcher runs back-to-back flushes that grow toward max_batch_size.
+    # Light-load coalescing (deadline semantics for stragglers) unchanged.
+    continuous_batching: bool = True
+    # Fleet (serving/pool.py + serving/router.py): engine replicas behind
+    # one frontend. 1 = the single-replica pre-fleet behavior; 0 = one
+    # replica per visible local device; N>1 explicit. Replicas targeting
+    # the same device share compiled programs (CPU correctness mode).
+    replicas: int = 1
+    # Router admission control: shed (HTTP 429 + Retry-After) when the
+    # routed replica already holds this many undispatched requests —
+    # BEFORE the request queues. 0 disables (the per-replica batcher's
+    # max_queue_depth shed stays as the inner backstop).
+    router_max_queued_per_replica: int = 0
     # Adapted-weight cache: content-addressed by (checkpoint fingerprint,
     # support-set digest); repeat clients skip the inner loop entirely.
     cache_max_bytes: int = 256 * 1024 * 1024
@@ -133,6 +149,16 @@ class ServingConfig:
             raise ValueError(f"max_batch_size must be >= 1, got {self.max_batch_size}")
         if self.batch_deadline_ms < 0:
             raise ValueError("batch_deadline_ms must be >= 0")
+        if self.replicas < 0:
+            raise ValueError(
+                f"serving.replicas must be >= 0 (0 = one per device), "
+                f"got {self.replicas}"
+            )
+        if self.router_max_queued_per_replica < 0:
+            raise ValueError(
+                "router_max_queued_per_replica must be >= 0 (0 = disabled), "
+                f"got {self.router_max_queued_per_replica}"
+            )
         if self.latency_window < 1:
             raise ValueError(f"latency_window must be >= 1, got {self.latency_window}")
 

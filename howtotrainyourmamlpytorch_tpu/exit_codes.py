@@ -44,6 +44,9 @@ TPU_WAIT_DEADLINE = 64
 TPU_WAIT_WEDGED = 65
 
 # --- serving HTTP degradation codes (serving/server.py) -------------------
+#: router admission control: the session's affine replica is at its
+#: admission bound — shed BEFORE queueing, sent with Retry-After
+HTTP_TOO_MANY_REQUESTS = 429
 #: load shed (queue full) or circuit breaker open — sent with Retry-After
 HTTP_UNAVAILABLE = 503
 #: one request ran past resilience.request_deadline_s

@@ -69,6 +69,8 @@ class RequestContext:
     sampled: bool = True  # traceparent sampled flag, echoed downstream
     # -- filled in as the request moves through the serving path --------
     bucket: Any = None  # shape bucket the frontend routed to
+    true_size: Optional[int] = None  # pre-padding sample count (waste acct)
+    replica: Optional[int] = None  # pool replica the router chose
     flush_batch: Optional[int] = None  # requests sharing the flush
     queue_wait_s: Optional[float] = None  # submit -> worker pickup
     dispatch_s: Optional[float] = None  # engine device dispatch
@@ -212,6 +214,8 @@ class AccessLog:
             "outcome": outcome,
             "status": status,
             "bucket": ctx.bucket,
+            "true_size": ctx.true_size,
+            "replica": ctx.replica,
             "flush_batch": ctx.flush_batch,
             "cache_hit": ctx.cache_hit,
             **ctx.timing_ms(total_s),

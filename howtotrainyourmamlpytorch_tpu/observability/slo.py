@@ -167,6 +167,12 @@ def _warm_batch_buckets(frontend, schedule, make_support, make_query, log) -> No
     (test doubles) — the single-request warmup already ran."""
     engine = getattr(frontend, "engine", None)
     prewarm = getattr(engine, "prewarm", None)
+    # a fleet frontend warms EVERY replica's engine (pool.prewarm dedups
+    # shared-engine replicas); single-replica and engine-only paths keep
+    # the direct engine warm
+    pool = getattr(frontend, "pool", None)
+    if pool is not None and len(pool) > 1 and prewarm is not None:
+        prewarm = pool.prewarm
     if engine is None or prewarm is None:
         log("loadgen: batch-bucket warmup skipped (frontend has no engine)")
         return
@@ -230,6 +236,7 @@ def run_load(
     _warm_batch_buckets(frontend, schedule, make_support, make_query, log)
     log(f"loadgen: warm ({len(ids)} adaptations cached)")
     breaker_before = frontend.breaker.snapshot()
+    opens_before = _breaker_opens_total(frontend, breaker_before)
 
     from ..resilience.retry import DeadlineExceededError
     from ..serving.server import ServiceUnavailableError
@@ -314,15 +321,38 @@ def run_load(
     if unresolved:
         log(f"loadgen: {unresolved} requests unresolved after {result_grace_s}s grace")
     breaker_after = frontend.breaker.snapshot()
-    return {
+    run: Dict[str, Any] = {
         "rows": results.rows(),
         "unresolved_by_stair": unresolved_by_stair,
         "unresolved": unresolved,
         "wall_s": round(wall_s, 3),
-        "breaker_trips": int(breaker_after.get("opens", 0))
-        - int(breaker_before.get("opens", 0)),
+        # fleet-aware: trips summed across every replica's breaker (a pool
+        # frontend), falling back to the single breaker on doubles
+        "breaker_trips": _breaker_opens_total(frontend, breaker_after)
+        - opens_before,
         "breaker": breaker_after,
     }
+    pool = getattr(frontend, "pool", None)
+    if pool is not None and len(pool) > 1:
+        # the per-replica story the fleet headline needs: outcome counts,
+        # breaker trips, and cache hit rates per failure domain
+        run["replicas"] = pool.stats()
+        router = getattr(frontend, "router", None)
+        if router is not None:
+            run["router"] = router.stats()
+    return run
+
+
+def _breaker_opens_total(frontend, breaker_snapshot: Dict[str, Any]) -> int:
+    """Lifetime breaker trips: summed across the pool when the frontend has
+    one, else the lone breaker's count (test doubles, older frontends)."""
+    pool = getattr(frontend, "pool", None)
+    if pool is not None:
+        try:
+            return int(pool.breaker_opens())
+        except Exception:  # noqa: BLE001 — doubles with a stub pool
+            pass
+    return int(breaker_snapshot.get("opens", 0))
 
 
 def _percentiles(latencies: List[float]) -> Dict[str, Optional[float]]:
@@ -474,5 +504,21 @@ def slo_report(
             "path": access_log_path,
             "lines": len(access_index),
         }
+    if "replicas" in run:
+        # the fleet headline's supporting cast: per-replica outcome counts,
+        # breaker trips, and cache hit rates, plus the router's verdicts
+        report["replicas"] = len(run["replicas"])
+        report["per_replica"] = [
+            {
+                "replica": r["replica"],
+                "alive": r["alive"],
+                "counts": r["counts"],
+                "breaker_opens": int(r["breaker"].get("opens", 0)),
+                "cache_hit_rate": r["cache"].get("hit_rate"),
+                "mean_batch": r["predict_batcher"].get("mean_batch"),
+            }
+            for r in run["replicas"]
+        ]
+        report["router"] = run.get("router")
     report.update(extra)
     return report

@@ -244,6 +244,11 @@ def episode_menu(rng: np.random.RandomState) -> List[Episode]:
         ),
         Episode(kind="serve-dispatch-raise", mode="serve"),
         Episode(kind="serve-dispatch-hang", mode="serve"),
+        # kill one replica of a 2-replica fleet mid-load: the router must
+        # stop routing to it, no request may be 200'd with a wrong/stale
+        # result, the fleet must keep serving, and the death must resolve
+        # through the access log (serving/pool.py, serving/router.py)
+        Episode(kind="serve-replica-death", mode="serve"),
     ]
     order = rng.permutation(len(menu))
     return [menu[i] for i in order]
@@ -511,7 +516,10 @@ def _run_serve_episode(ep: Episode) -> List[str]:
                             )
                 except urllib.error.HTTPError as exc:
                     statuses.append(exc.code)
-                    if exc.code not in (400, 404, 500, 503, 504):
+                    if exc.code not in (
+                        400, 404, exit_codes.HTTP_TOO_MANY_REQUESTS, 500,
+                        exit_codes.HTTP_UNAVAILABLE, exit_codes.HTTP_DEADLINE,
+                    ):
                         violations.append(f"undocumented HTTP status {exc.code}")
                     if exc.code == 503 and "Retry-After" not in exc.headers:
                         violations.append("503 without Retry-After")
@@ -593,6 +601,86 @@ def _run_serve_episode(ep: Episode) -> List[str]:
             json.dumps(frontend.metrics())  # observability stays well-formed
         finally:
             frontend.close()
+    elif ep.kind == "serve-replica-death":
+        # kill one replica of a 2-replica fleet mid-load. Invariants:
+        # (1) the router stops routing to the dead replica, (2) no request
+        # is 200'd with a wrong/stale result (the displaced session's
+        # predict must 404-class, never silently succeed elsewhere; after
+        # re-adapt its predictions must be bit-identical to a healthy
+        # fleet's), (3) the fleet keeps serving, (4) the death resolves
+        # through the access log (a replica_death line + rerouted request
+        # lines naming their replica).
+        import tempfile
+
+        from ..observability.context import read_access_log
+        from ..serving import UnknownAdaptationError
+
+        engine = AdaptationEngine(system, system.init_train_state())
+        access_dir = tempfile.mkdtemp(prefix="chaos_access_")
+        frontend = ServingFrontend(engine, access_log_dir=access_dir, replicas=2)
+        owner = None
+        try:
+            epi = synthetic_batch(1, 5, 2, 3, img, seed=11)
+            x_s, y_s = epi["x_support"][0], epi["y_support"][0]
+            x_q = epi["x_target"][0].reshape((-1,) + img)
+            info = frontend.adapt(x_s, y_s)
+            probs_before = frontend.predict(info["adaptation_id"], x_q)
+            owner = frontend.router.route(info["adaptation_id"]).index
+            frontend.kill_replica(owner, reason="chaos")
+            routed_at_death = frontend.router.stats()["routed"][owner]
+            # (2) the displaced session must NOT be silently served a
+            # result by a replica that never adapted it
+            try:
+                frontend.predict(info["adaptation_id"], x_q)
+                violations.append(
+                    "predict for a dead replica's session succeeded without "
+                    "re-adapting — possible stale/wrong 200"
+                )
+            except UnknownAdaptationError:
+                pass
+            # (3) the fleet keeps serving: re-adapt lands on the survivor
+            # and predictions match the pre-death fleet bit-identically
+            info2 = frontend.adapt(x_s, y_s)
+            probs_after = frontend.predict(info2["adaptation_id"], x_q)
+            if not np.array_equal(
+                np.asarray(probs_before), np.asarray(probs_after)
+            ):
+                violations.append(
+                    "post-failover predictions differ from the healthy "
+                    "fleet's — wrong result served after replica death"
+                )
+            # (1) no NEW route went to the dead replica
+            stats = frontend.router.stats()
+            if stats["routed"][owner] != routed_at_death:
+                violations.append(
+                    f"router still routed to dead replica r{owner}: {stats}"
+                )
+            if stats["routable"] != 1 or stats["routed_around"] < 1:
+                violations.append(f"router did not route around the death: {stats}")
+            health = frontend.healthz()
+            if health["status"] != "degraded" or health["routable"] != 1:
+                violations.append(f"healthz does not reflect the death: {health}")
+            json.dumps(frontend.metrics())  # observability stays well-formed
+        finally:
+            frontend.close()
+        # (4) the death is an access-log-resolvable event
+        records, torn = read_access_log(os.path.join(access_dir, "access.jsonl"))
+        if torn:
+            violations.append(f"{torn} torn access.jsonl line(s)")
+        deaths = [r for r in records if r.get("verb") == "replica_death"]
+        if owner is None or not deaths or deaths[0].get("replica") != owner:
+            violations.append(
+                f"replica death not resolvable from the access log: {deaths}"
+            )
+        served_after = [
+            r
+            for r in records
+            if r.get("outcome") == "ok" and r.get("replica") not in (None, owner)
+        ]
+        if not served_after:
+            violations.append(
+                "no post-death access line names a surviving replica"
+            )
     else:
         violations.append(f"unknown serve episode kind {ep.kind!r}")
     return violations

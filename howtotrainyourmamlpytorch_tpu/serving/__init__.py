@@ -11,8 +11,12 @@ server:
   request shapes don't recompile and padding never changes predictions);
 - :mod:`cache` — ``AdaptedWeightCache``: content-addressed LRU of adapted
   parameter trees (byte budget, TTL, hit/miss/eviction counters);
-- :mod:`batcher` — ``MicroBatcher``: deadline/max-batch micro-batching of
-  concurrent requests into single device dispatches;
+- :mod:`batcher` — ``MicroBatcher``: deadline/max-batch micro-batching —
+  continuous under load — of concurrent requests into device dispatches;
+- :mod:`pool` — ``EnginePool``/``EngineReplica``: one engine replica per
+  local device, each with its own batchers, breaker, and cache;
+- :mod:`router` — ``Router``: cache-affinity routing (rendezvous hashing on
+  the adapted-weight cache key) + admission control shed;
 - :mod:`metrics` — ``LatencyStats``: per-phase p50/p95/p99;
 - :mod:`server` — ``ServingFrontend`` (in-process API) + a stdlib
   ``ThreadingHTTPServer`` JSON front-end (``scripts/serve.py``).
@@ -21,11 +25,12 @@ server:
 from .batcher import MicroBatcher, QueueFullError  # noqa: F401
 from .cache import AdaptedWeightCache, support_digest, tree_bytes  # noqa: F401
 from .engine import AdaptationEngine  # noqa: F401
+from .errors import ServiceUnavailableError, UnknownAdaptationError  # noqa: F401
 from .metrics import EventCounters, LatencyStats  # noqa: F401
+from .pool import EnginePool, EngineReplica  # noqa: F401
+from .router import NoRoutableReplicaError, Router  # noqa: F401
 from .server import (  # noqa: F401
-    ServiceUnavailableError,
     ServingFrontend,
-    UnknownAdaptationError,
     frontend_from_run_dir,
     make_http_server,
     serve_forever,

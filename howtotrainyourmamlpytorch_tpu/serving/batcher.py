@@ -8,6 +8,15 @@ reaches ``max_batch`` requests or when its oldest request has waited
 enough to be invisible next to an inner-loop rollout). One worker thread owns
 all flushes, so device dispatch is serialized — no jit-cache races, no
 interleaved transfers.
+
+``continuous=True`` adds the Orca lesson (iteration-level scheduling; Yu et
+al., OSDI'22) at this batcher's granularity: requests arriving while a flush
+is in flight are admitted into the NEXT flush the moment the worker frees,
+instead of waiting out their own deadline window. Under load the worker runs
+back-to-back flushes whose sizes grow toward ``max_batch``; at light load
+nothing changes — an idle worker still holds a lone request for
+``deadline_ms`` hoping to coalesce a burst, so the deadline semantics
+stragglers rely on are preserved.
 """
 
 import threading
@@ -44,6 +53,7 @@ class MicroBatcher:
         max_queue_depth: int = None,
         tracer=None,
         pass_contexts: bool = False,
+        continuous: bool = False,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -59,6 +69,7 @@ class MicroBatcher:
         self.max_batch = int(max_batch)
         self.deadline_s = float(deadline_ms) / 1000.0
         self.max_queue_depth = None if max_queue_depth is None else int(max_queue_depth)
+        self.continuous = bool(continuous)
         self.name = name
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -73,6 +84,14 @@ class MicroBatcher:
         self.shed = 0  # submits refused at max_queue_depth
         self.flushes_full = 0
         self.flushes_deadline = 0
+        # continuous-mode flushes: requests admitted while the previous
+        # flush was in flight, dispatched the moment the worker freed —
+        # under load these dominate and the deadline never paces a flush
+        self.flushes_continuous = 0
+        # set after every completed flush, cleared when the worker finds the
+        # queue empty: only requests that queued DURING a flush skip their
+        # deadline window (a straggler arriving at an idle worker does not)
+        self._just_flushed = False
         # flushes whose flush_fn RETURNED (result or exception) — the
         # worker-progress signal server._dispatch uses to tell a backlogged
         # worker from a wedged one when a queued request's deadline expires
@@ -129,13 +148,16 @@ class MicroBatcher:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            flushes = self.flushes_full + self.flushes_deadline
+            flushes = (
+                self.flushes_full + self.flushes_deadline + self.flushes_continuous
+            )
             return {
                 "requests": self.requests,
                 "shed": self.shed,
                 "flushes": flushes,
                 "flushes_full": self.flushes_full,
                 "flushes_deadline": self.flushes_deadline,
+                "flushes_continuous": self.flushes_continuous,
                 "flushes_done": self.flushes_done,
                 "batched_requests": self.batched_requests,
                 "mean_batch": (self.requests / flushes) if flushes else 0.0,
@@ -166,12 +188,19 @@ class MicroBatcher:
         return taken
 
     def _pop_ready_locked(self, now: float):
-        """The next batch due for flush: any group at max_batch, else one
-        whose head has passed the deadline; None when nothing is due."""
+        """The next batch due for flush: any group at max_batch, else — in
+        continuous mode, right after a flush — the oldest-head group (its
+        requests queued while the worker was busy; making them wait out a
+        deadline on top would be pure idle time), else one whose head has
+        passed the deadline; None when nothing is due."""
         for key, group in self._groups.items():
             if len(group) >= self.max_batch:
                 self.flushes_full += 1
                 return key, self._take_locked(key)
+        if self.continuous and self._just_flushed and self._groups:
+            key = min(self._groups, key=lambda k: self._groups[k][0][2])
+            self.flushes_continuous += 1
+            return key, self._take_locked(key)
         for key, group in list(self._groups.items()):
             if now - group[0][2] >= self.deadline_s:
                 self.flushes_deadline += 1
@@ -201,6 +230,9 @@ class MicroBatcher:
                         )
                         self._wake.wait(timeout=max(next_due - now, 0.0))
                     else:
+                        # queue drained: the next arrival meets an idle
+                        # worker and gets the full coalescing window
+                        self._just_flushed = False
                         self._wake.wait()
                 if len(ready[1]) > 1:
                     self.batched_requests += len(ready[1])
@@ -217,6 +249,10 @@ class MicroBatcher:
                 # watchdog rc=76s a demonstrably live worker
                 with self._lock:
                     self.flushes_done += 1
+                    # arm continuous pickup ONLY for requests that queued
+                    # while this flush was in flight; a later straggler at
+                    # the then-idle worker keeps its coalescing deadline
+                    self._just_flushed = bool(self._groups)
                 continue
             payloads = [p for p, _, _, _ in group]
             # stamp each request's journey through this flush BEFORE the
@@ -251,12 +287,14 @@ class MicroBatcher:
                 with self._lock:
                     self.flushes_done += 1  # an exception is still progress
                     self.in_flight = 0
+                    self._just_flushed = bool(self._groups)
                 for _, fut, _, _ in group:
                     self._complete(fut, exc=exc)
                 continue
             with self._lock:
                 self.flushes_done += 1
                 self.in_flight = 0
+                self._just_flushed = bool(self._groups)
             for (_, fut, _, _), res in zip(group, results):
                 self._complete(fut, result=res)
 

@@ -74,10 +74,20 @@ class AdaptationEngine:
         strict: Optional[bool] = None,
         tracer=None,
         compile_ledger=None,
+        device=None,
+        ledger_tag: str = "",
     ):
         self.system = system
         self.cfg = system.cfg
         self.serving = serving_cfg or self.cfg.serving
+        # fleet placement (serving/pool.py): an engine bound to a device
+        # commits its restored state there, so every jit dispatch follows
+        # the committed operands onto that device — one replica per device
+        # without touching the compiled programs. None = default placement.
+        self.device = device
+        # per-replica compile-ledger key prefix ("@r1"): same-named bucket
+        # programs built by different replicas stay distinct ledger rows
+        self.ledger_tag = str(ledger_tag)
         # span tracer for the dispatch hot path (observability/trace.py);
         # NULL_TRACER costs one attribute lookup per span. ServingFrontend
         # swaps its hub's tracer in when observability is enabled.
@@ -101,6 +111,8 @@ class AdaptationEngine:
                 step=jnp.asarray(state.step, jnp.int32),
             )
         self.state: TrainState = jax.tree.map(jnp.asarray, state)
+        if device is not None:
+            self.state = jax.device_put(self.state, device)
         self.fingerprint = fingerprint or "live"
         self.num_steps = (
             self.serving.adapt_steps
@@ -163,6 +175,31 @@ class AdaptationEngine:
         engine.save_dir = save_dir
         return engine
 
+    def clone_for_device(self, device, index: int) -> "AdaptationEngine":
+        """A replica of this engine bound to ``device`` (serving/pool.py):
+        same system, config, fingerprint, fault injector, and compile
+        ledger (tagged ``@r<index>`` so its bucket programs stay distinct
+        ledger rows), with the state committed to the target device. The
+        jit caches are per-clone — each device compiles (or, with the
+        persistent cache / executable store, loads) its own executables."""
+        clone = AdaptationEngine(
+            self.system,
+            self.state,
+            serving_cfg=self.serving,
+            fingerprint=self.fingerprint,
+            injector=self.injector,
+            strict=self.recompile_guard is not None,
+            tracer=self.tracer,
+            compile_ledger=self.compile_ledger,
+            device=device,
+            ledger_tag=f"@r{index}",
+        )
+        # replicas of a run-dir engine share its executable store: the
+        # first replica's serialized executables warm every later one
+        if getattr(self, "save_dir", None):
+            clone.save_dir = self.save_dir
+        return clone
+
     # ------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------
@@ -185,7 +222,9 @@ class AdaptationEngine:
 
                 fn = jax.jit(adapt_batched)
                 if self.compile_ledger is not None:
-                    fn = self.compile_ledger.wrap_build(("serve_adapt",) + key, fn)
+                    fn = self.compile_ledger.wrap_build(
+                        (f"serve_adapt{self.ledger_tag}",) + key, fn
+                    )
                 self._adapt_jit[key] = fn
         return fn
 
@@ -206,7 +245,9 @@ class AdaptationEngine:
 
                 fn = jax.jit(predict_batched)
                 if self.compile_ledger is not None:
-                    fn = self.compile_ledger.wrap_build(("serve_predict",) + key, fn)
+                    fn = self.compile_ledger.wrap_build(
+                        (f"serve_predict{self.ledger_tag}",) + key, fn
+                    )
                 self._predict_jit[key] = fn
         return fn
 

@@ -19,7 +19,6 @@ threaded server (one thread per in-flight request) feeding the batchers,
 whose single worker serializes device dispatch.
 """
 
-import concurrent.futures
 import json
 import os
 import sys
@@ -45,27 +44,18 @@ from ..observability.context import (
 )
 from ..observability.metrics import prometheus_text
 from ..observability.trace import NULL_TRACER
-from ..resilience.breaker import CircuitBreaker
 from ..resilience.retry import DeadlineExceededError
 from ..resilience.watchdog import HeartbeatWatchdog
-from .batcher import MicroBatcher, QueueFullError
-from .cache import AdaptedWeightCache, support_digest
+from .cache import support_digest
 from .engine import AdaptationEngine
+
+# historical home of the request-path error taxonomy: re-exported so every
+# ``from .server import ServiceUnavailableError`` keeps resolving to the
+# one class the pool/router layers now raise from below the frontend
+from .errors import ServiceUnavailableError, UnknownAdaptationError  # noqa: F401
 from .metrics import EventCounters, LatencyStats
-
-
-class UnknownAdaptationError(KeyError):
-    """predict() named an adaptation id that is not (or no longer) cached."""
-
-
-class ServiceUnavailableError(RuntimeError):
-    """The frontend refused the request without dispatching it — queue full
-    (load shed) or circuit breaker open. The HTTP layer maps this to 503 with
-    a ``Retry-After`` header so clients back off instead of hammering."""
-
-    def __init__(self, message: str, retry_after_s: float):
-        super().__init__(message)
-        self.retry_after_s = float(retry_after_s)
+from .pool import EnginePool
+from .router import Router
 
 
 class ServingFrontend:
@@ -78,9 +68,17 @@ class ServingFrontend:
         wedge_exit=None,
         hub: Optional[TelemetryHub] = None,
         access_log_dir: Optional[str] = None,
+        replicas: Optional[int] = None,
     ):
         self.engine = engine
         self.serving = serving_cfg or engine.serving
+        # fleet size: explicit arg > Config.serving.replicas; 0 = one
+        # replica per visible local device (serving/pool.py)
+        self._n_replicas = (
+            int(replicas)
+            if replicas is not None
+            else int(getattr(self.serving, "replicas", 1))
+        )
         # resilience knobs ride the run config like the serving knobs do;
         # clock is injectable so breaker tests walk cooldowns without waiting
         self.resilience = resilience_cfg or engine.cfg.resilience
@@ -94,9 +92,6 @@ class ServingFrontend:
             else TelemetryHub.from_config(
                 getattr(engine.cfg, "observability", None)
             )
-        )
-        self.cache = AdaptedWeightCache(
-            max_bytes=self.serving.cache_max_bytes, ttl_s=self.serving.cache_ttl_s
         )
         self.latency = LatencyStats(
             self.serving.latency_window, registry=self.hub.registry
@@ -147,38 +142,37 @@ class ServingFrontend:
                     getattr(obs_cfg, "hbm_headroom_warn_frac", 0.05)
                 )
                 self.hub.add_provider("memory", self._memory.snapshot)
-        self.breaker = CircuitBreaker(
-            failure_threshold=self.resilience.breaker_failure_threshold,
-            cooldown_s=self.resilience.breaker_cooldown_s,
-            half_open_probes=self.resilience.breaker_half_open_probes,
-            timeout_threshold=self.resilience.breaker_timeout_threshold,
+        # --- the fleet: pool + router (serving/pool.py, serving/router.py)
+        # One EngineReplica per requested replica, each with its own
+        # batchers (continuous batching), circuit breaker, and adapted-
+        # weight cache; the router keeps sessions affine to the replica
+        # holding their fast weights and sheds at admission. With one
+        # replica everything below collapses to the pre-fleet behavior.
+        self.pool = EnginePool.build(
+            engine,
+            self._n_replicas,
+            serving_cfg=self.serving,
+            resilience_cfg=self.resilience,
+            counters=self.counters,
+            tracer=self.hub.tracer,
             clock=clock,
         )
-        # pass_contexts: the request contexts ride the queue with their
-        # payloads so the flush stamps queue-wait/flush-batch and the engine
-        # finishes each trace flow at its dispatch span
-        self._adapt_batcher = MicroBatcher(
-            lambda bucket, payloads, ctxs: self.engine.adapt_batch(
-                payloads, ctxs=ctxs
+        self.router = Router(
+            self.pool.replicas,
+            max_queued_per_replica=getattr(
+                self.serving, "router_max_queued_per_replica", 0
             ),
-            max_batch=self.serving.max_batch_size,
-            deadline_ms=self.serving.batch_deadline_ms,
-            name="adapt",
-            max_queue_depth=self.resilience.max_queue_depth,
-            tracer=self.hub.tracer,
-            pass_contexts=True,
+            shed_retry_after_s=self.resilience.shed_retry_after_s,
         )
-        self._predict_batcher = MicroBatcher(
-            lambda bucket, payloads, ctxs: self.engine.predict_batch(
-                payloads, ctxs=ctxs
-            ),
-            max_batch=self.serving.max_batch_size,
-            deadline_ms=self.serving.batch_deadline_ms,
-            name="predict",
-            max_queue_depth=self.resilience.max_queue_depth,
-            tracer=self.hub.tracer,
-            pass_contexts=True,
-        )
+        # back-compat views: the single-replica surface tests, the SLO
+        # harness, and operator tools read — all primary-replica objects
+        primary = self.pool.replicas[0]
+        self.breaker = primary.breaker
+        self.cache = primary.cache
+        self._adapt_batcher = primary.adapt_batcher
+        self._predict_batcher = primary.predict_batcher
+        if self.hub.enabled and len(self.pool) > 1:
+            self.hub.add_provider("router", self.router.stats)
         self._started = time.monotonic()
         self._closed = False
         # --- AOT prewarm (Config.aot; compile/aot.py) --------------------
@@ -218,7 +212,12 @@ class ServingFrontend:
         self._watchdogs: list = []
         wd_cfg = getattr(self.resilience, "watchdog", None)
         if wd_cfg is not None and wd_cfg.enabled and wd_cfg.serve_enabled:
-            for batcher in (self._adapt_batcher, self._predict_batcher):
+            batchers = [
+                b
+                for r in self.pool.replicas
+                for b in (r.adapt_batcher, r.predict_batcher)
+            ]
+            for batcher in batchers:
                 wd = HeartbeatWatchdog(
                     deadline_s=wd_cfg.serve_deadline_s,
                     poll_s=wd_cfg.poll_s,
@@ -239,7 +238,14 @@ class ServingFrontend:
         compiles with the error visible in /metrics, never a dead server."""
         t0 = time.monotonic()
         try:
-            summary = self.engine.prewarm()
+            # per-replica warm gating (compile/aot.py::prewarm_pool): every
+            # DISTINCT engine behind the pool is warmed; same-device
+            # replicas share the primary's warm set for free
+            summary = (
+                self.pool.prewarm()
+                if len(self.pool) > 1
+                else self.engine.prewarm()
+            )
             result = {
                 "status": "warm",
                 "programs": summary["programs"],
@@ -248,6 +254,8 @@ class ServingFrontend:
                 "store_hits": summary.get("store_hits", 0),
                 "compile_errors": summary["errors"],
             }
+            if "replicas" in summary:
+                result["replicas"] = summary["replicas"]
         except Exception as exc:  # noqa: BLE001 — prewarm must not kill serving
             result = {
                 "status": "error",
@@ -291,8 +299,8 @@ class ServingFrontend:
                     "component": "serving",
                     "stage": info["stage"],
                     "stall_s": info["stall_s"],
-                    "adapt_batcher": self._adapt_batcher.stats(),
-                    "predict_batcher": self._predict_batcher.stats(),
+                    "adapt_batcher": self.pool.batcher_stats("adapt"),
+                    "predict_batcher": self.pool.batcher_stats("predict"),
                 }
             ),
             file=sys.stderr,
@@ -352,74 +360,88 @@ class ServingFrontend:
         """Map a request-path exception to its (outcome, HTTP status) pair
         — the access log's taxonomy, identical in-process and over HTTP."""
         if isinstance(exc, ServiceUnavailableError):
-            return "shed", HTTP_UNAVAILABLE
+            # 503 for replica-side refusals, 429 for router admission —
+            # the error carries its own wire status (serving/errors.py)
+            return "shed", exc.status
         if isinstance(exc, DeadlineExceededError):
             return "deadline", HTTP_DEADLINE
         if isinstance(exc, UnknownAdaptationError):
             return "unknown_id", 404
         return "error", 500
 
-    def _dispatch(self, batcher: MicroBatcher, bucket, payload, ctx=None):
-        """One guarded device dispatch: circuit breaker (fail fast while the
-        device path is known-bad), queue-depth shed (bounded tail latency),
-        per-request deadline (no caller waits forever on a wedged device).
-        Dispatch failures/successes feed the breaker, and so do deadline
-        timeouts that look like a hang (zero flushes completed across the
-        whole wait) — under their own (breaker_timeout_threshold) streak,
-        since a wedged backend never raises. Pure client-side refusals
-        (shed, breaker-open, deadline expiry on a worker that is visibly
-        making progress) do not — they say nothing about device health."""
-        res = self.resilience
-        permit = self.breaker.allow()
-        if permit is None:
-            self.counters.inc("breaker_rejected")
-            raise ServiceUnavailableError(
-                f"engine circuit breaker {self.breaker.state}; retry after "
-                f"cooldown",
-                retry_after_s=res.breaker_cooldown_s,
+    def _dispatch(self, batcher, bucket, payload, ctx=None):
+        """Back-compat seam: the guarded dispatch (breaker + shed +
+        deadline + timeout attribution) lives on
+        :class:`~.pool.EngineReplica` now; this delegates to the primary
+        replica's guard for callers (tests, tools) that drive it with an
+        arbitrary batcher."""
+        return self.pool.replicas[0].dispatch(batcher, bucket, payload, ctx)
+
+    def _note_padding(self, verb: str, true_size: int, bucket) -> None:
+        """Padding-waste accounting (ROADMAP 4d): forward FLOPs scale with
+        the PADDED sample count, so the wasted-FLOPs fraction over traffic
+        is ``1 - true_samples / padded_samples``. Called AFTER a dispatch
+        returns, so only FLOPs actually spent are counted (cache hits,
+        sheds, breaker rejections, and deadline expiries pad nothing); the
+        live ``padding_waste_frac`` gauge rides the one registry /metrics,
+        the hub, and the prom exposition read."""
+        if not isinstance(bucket, (int, np.integer)) or bucket <= 0:
+            return
+        reg = self.hub.registry
+        reg.inc(f"serving.padding.{verb}.true_samples", int(true_size))
+        reg.inc(f"serving.padding.{verb}.padded_samples", int(bucket))
+        true_total = sum(
+            reg.counter(f"serving.padding.{v}.true_samples")
+            for v in ("adapt", "predict")
+        )
+        padded_total = sum(
+            reg.counter(f"serving.padding.{v}.padded_samples")
+            for v in ("adapt", "predict")
+        )
+        if padded_total:
+            reg.set_gauge(
+                "serving.padding_waste_frac",
+                round(1.0 - true_total / padded_total, 4),
             )
-        # worker-progress mark, read BEFORE submit: any flush completing
-        # while we wait counts as progress when attributing a timeout below
-        progress_mark = batcher.flushes_completed()
-        try:
-            fut = batcher.submit(bucket, payload, ctx=ctx)
-        except QueueFullError as exc:
-            # never dispatched: a half-open probe slot this call consumed
-            # must be returned or the breaker wedges in half_open (the permit
-            # makes this a no-op unless this exact call took the slot)
-            self.breaker.release_probe(permit)
-            self.counters.inc("shed")
-            raise ServiceUnavailableError(
-                str(exc), retry_after_s=res.shed_retry_after_s
-            ) from exc
-        try:
-            result = fut.result(timeout=res.request_deadline_s)
-        except concurrent.futures.TimeoutError as exc:
-            fut.cancel()  # drop it if still queued; a racing flush is harmless
-            # attribute the expiry before feeding the breaker. The worker
-            # completing ANY flush while we waited means the device is
-            # making progress and this expiry is queue-wait (or a one-off
-            # slow dispatch) on a busy device — overload evidence, not
-            # wedge evidence, so only the probe slot (if any) is returned.
-            # Zero flushes completed across the whole deadline is the hang
-            # signature: a timed-out probe re-opens the breaker (its slot
-            # is reclaimed by the trip), and repeated closed-state timeouts
-            # trip it at breaker_timeout_threshold.
-            if batcher.flushes_completed() != progress_mark:
-                self.breaker.release_probe(permit)
-                self.counters.inc("queue_wait_expired")
-            else:
-                self.breaker.record_timeout(permit)
-            self.counters.inc("deadline_exceeded")
-            raise DeadlineExceededError(
-                f"request exceeded the {res.request_deadline_s}s deadline"
-            ) from exc
-        except Exception:
-            self.counters.inc("dispatch_failures")
-            self.breaker.record_failure(permit)
-            raise
-        self.breaker.record_success(permit)
-        return result
+
+    def padding_stats(self) -> Dict[str, Any]:
+        """The /metrics ``padding`` block: per-verb true vs padded sample
+        totals and waste fractions — the number bucket-edge tuning reads."""
+        reg = self.hub.registry
+        out: Dict[str, Any] = {}
+        true_total = padded_total = 0
+        for verb in ("adapt", "predict"):
+            t = reg.counter(f"serving.padding.{verb}.true_samples")
+            p = reg.counter(f"serving.padding.{verb}.padded_samples")
+            true_total += t
+            padded_total += p
+            out[verb] = {
+                "true_samples": t,
+                "padded_samples": p,
+                "padding_waste_frac": round(1.0 - t / p, 4) if p else None,
+            }
+        out["padding_waste_frac"] = (
+            round(1.0 - true_total / padded_total, 4) if padded_total else None
+        )
+        return out
+
+    def kill_replica(self, index: int, reason: str = "operator") -> None:
+        """Mark one replica dead (chaos drills, operator action): the
+        router stops routing to it from the next request on, the rest of
+        the fleet keeps serving, and the death lands in the access log as
+        a synthetic ``replica_death`` line — the access-log-resolvable
+        event the chaos invariant greps for (non-``ok`` outcomes bypass
+        sampling by contract)."""
+        replica = self.pool.replicas[index]
+        replica.kill(reason)
+        self.counters.inc("replica_deaths")
+        if self.access_log is not None:
+            ctx = new_request_context()
+            ctx.replica = index
+            self.access_log.record(
+                ctx, "replica_death", "dead", None, None,
+                replica=index, reason=reason,
+            )
 
     def adapt(self, x_support, y_support, ctx: Optional[RequestContext] = None) -> Dict[str, Any]:
         ctx = self._request_ctx(ctx)
@@ -435,15 +457,24 @@ class ServingFrontend:
                 x, y = self.engine._flatten_support(x_support, y_support)
                 digest = support_digest(x, y, self.engine.num_steps)
                 key = self._cache_key(digest)
-                cached = self.cache.get(key, ctx=ctx) is not None
+                # affinity on the cache key: this session's fast weights
+                # live (or will live) on exactly this replica's cache
+                replica = self.router.route(digest, ctx=ctx)
+                cached = replica.cache.get(key, ctx=ctx) is not None
                 if not cached:
+                    # shed at the router BEFORE the request queues at the
+                    # replica (a cache hit above costs nothing — only real
+                    # work passes admission)
+                    self.router.admit(replica)
                     bucket = self.engine.support_bucket(x.shape[0])
                     if ctx is not None:
                         ctx.bucket = bucket
-                    fast_weights = self._dispatch(
-                        self._adapt_batcher, bucket, (x, y), ctx
+                        ctx.true_size = int(x.shape[0])
+                    fast_weights = replica.dispatch(
+                        replica.adapt_batcher, bucket, (x, y), ctx
                     )
-                    self.cache.put(key, fast_weights)
+                    self._note_padding("adapt", x.shape[0], bucket)
+                    replica.cache.put(key, fast_weights)
         except BaseException as exc:
             outcome, status = self._failure_of(exc)
             self._record_access(ctx, "adapt", outcome, status, time.monotonic() - t0)
@@ -470,19 +501,30 @@ class ServingFrontend:
                 "serve.predict", flows=flow_start(ctx),
                 trace=ctx.trace_id if ctx else None,
             ):
-                fast_weights = self.cache.get(self._cache_key(adaptation_id), ctx=ctx)
+                # same affinity key as the adapt that cached these weights
+                # (the adaptation id IS the support digest), so the session
+                # lands on the replica whose cache holds them. After a
+                # replica death the key remaps and the miss below is the
+                # honest failover answer: re-adapt, never a stale result.
+                replica = self.router.route(adaptation_id, ctx=ctx)
+                fast_weights = replica.cache.get(
+                    self._cache_key(adaptation_id), ctx=ctx
+                )
                 if fast_weights is None:
                     raise UnknownAdaptationError(
                         f"unknown or expired adaptation_id {adaptation_id!r}; "
                         "re-send the support set via /adapt"
                     )
+                self.router.admit(replica)
                 x = np.asarray(x_query, np.float32)
                 bucket = self.engine.query_bucket(x.shape[0])
                 if ctx is not None:
                     ctx.bucket = bucket
-                probs = self._dispatch(
-                    self._predict_batcher, bucket, (fast_weights, x), ctx
+                    ctx.true_size = int(x.shape[0])
+                probs = replica.dispatch(
+                    replica.predict_batcher, bucket, (fast_weights, x), ctx
                 )
+                self._note_padding("predict", x.shape[0], bucket)
         except BaseException as exc:
             outcome, status = self._failure_of(exc)
             self._record_access(ctx, "predict", outcome, status, time.monotonic() - t0)
@@ -518,8 +560,15 @@ class ServingFrontend:
         # says degraded) because the breaker can only close via real requests
         # passing as probes — a drained backend would stay degraded forever.
         # OPERATIONS.md "Degraded modes".
-        breaker_state = self.breaker.state
-        degraded = [] if breaker_state == "closed" else [f"breaker_{breaker_state}"]
+        solo = len(self.pool) == 1
+        degraded = []
+        for replica in self.pool.replicas:
+            tag = "" if solo else f":r{replica.index}"
+            if not replica.alive:
+                degraded.append(f"replica_dead{tag}")
+            elif replica.breaker.state != "closed":
+                degraded.append(f"breaker_{replica.breaker.state}{tag}")
+        routable = sum(1 for r in self.pool.replicas if r.routable())
         prewarm = self.prewarm_status()
         # "warming" is its own state, not a degradation: the replica is
         # healthy but would eat cold XLA compiles — the HTTP layer 503s it
@@ -531,6 +580,11 @@ class ServingFrontend:
         return {
             "status": status,
             "degraded": degraded,
+            "replicas": len(self.pool),
+            # the HTTP layer's 503 signal: zero routable replicas means no
+            # request can be served — drain traffic; a PARTIALLY degraded
+            # fleet keeps answering 200 with the body naming what is down
+            "routable": routable,
             "prewarm": prewarm,
             "breaker": self.breaker.snapshot(),
             "platform": jax.default_backend(),
@@ -545,10 +599,16 @@ class ServingFrontend:
         out = {
             "prewarm": self.prewarm_status(),
             "latency": self.latency.summary(),
-            "cache": self.cache.stats(),
-            "adapt_batcher": self._adapt_batcher.stats(),
-            "predict_batcher": self._predict_batcher.stats(),
+            # fleet aggregates under the historical single-replica keys
+            # (counts summed, rates recomputed) — scrapers keep working;
+            # the per-replica story is the "replicas" block below
+            "cache": self.pool.cache_stats(),
+            "adapt_batcher": self.pool.batcher_stats("adapt"),
+            "predict_batcher": self.pool.batcher_stats("predict"),
             "compiled": self.engine.compile_counts(),
+            "router": self.router.stats(),
+            "replicas": self.pool.stats(),
+            "padding": self.padding_stats(),
             "resilience": {
                 **self.counters.snapshot(),
                 "breaker": self.breaker.snapshot(),
@@ -575,20 +635,22 @@ class ServingFrontend:
         self._closed = True
         for wd in self._watchdogs:
             wd.stop()
-        self._adapt_batcher.close()
-        self._predict_batcher.close()
+        self.pool.close()
         if self.access_log is not None:
             self.access_log.close()
 
 
 def frontend_from_run_dir(
-    run_dir: str, checkpoint_idx="best", cfg: Optional[Config] = None
+    run_dir: str,
+    checkpoint_idx="best",
+    cfg: Optional[Config] = None,
+    replicas: Optional[int] = None,
 ) -> ServingFrontend:
     engine = AdaptationEngine.from_run_dir(run_dir, checkpoint_idx, cfg=cfg)
     # a run-dir frontend owns the run's logs/: access.jsonl lands next to
     # telemetry.jsonl and events.jsonl so trace_merge finds them together
     return ServingFrontend(
-        engine, access_log_dir=os.path.join(run_dir, "logs")
+        engine, access_log_dir=os.path.join(run_dir, "logs"), replicas=replicas
     )
 
 
@@ -673,15 +735,16 @@ class _Handler(BaseHTTPRequestHandler):
             query = urllib.parse.parse_qs(split.query)
             if path == "/healthz":
                 health = frontend.healthz()
-                # 503 while the breaker is OPEN (drain a failing device) or
-                # while the AOT prewarm is still compiling (hold traffic off
-                # a cold replica — body status "warming", distinct from
-                # "degraded"); half-open must keep receiving traffic
-                # (probes are real requests) or the breaker could never
-                # close — the body still says exactly what is degraded
+                # 503 while NO replica is routable (every breaker OPEN or
+                # every replica dead — drain traffic) or while the AOT
+                # prewarm is still compiling (hold traffic off a cold
+                # replica — body status "warming", distinct from
+                # "degraded"); half-open replicas stay routable (probes are
+                # real requests) and a PARTIALLY degraded fleet keeps
+                # answering 200 — the body names exactly what is down
                 code = (
                     HTTP_UNAVAILABLE
-                    if "breaker_open" in health["degraded"]
+                    if health["routable"] == 0
                     or health["status"] == "warming"
                     else 200
                 )
@@ -736,9 +799,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._log_http(frontend, "not_found", 404)
                 self._send_json(404, {"error": f"unknown path {self.path}"})
         except ServiceUnavailableError as exc:
-            # load shed / breaker open: tell the client when to come back
+            # load shed / breaker open (503) or router admission (429):
+            # tell the client when to come back
             self._send_json(
-                HTTP_UNAVAILABLE,
+                exc.status,
                 {"error": str(exc), "retry_after_s": exc.retry_after_s},
                 # Retry-After is integer seconds (RFC 9110); round up so a
                 # sub-second hint doesn't become an immediate retry storm
@@ -773,7 +837,8 @@ def serve_forever(frontend: ServingFrontend, host: str, port: int) -> None:
     print(
         f"serving on http://{addr[0]}:{addr[1]} "
         f"(checkpoint {frontend.engine.fingerprint[:12]}, "
-        f"platform {jax.default_backend()})",
+        f"platform {jax.default_backend()}, "
+        f"{len(frontend.pool)} replica(s))",
         flush=True,
     )
     try:

@@ -63,6 +63,12 @@ def main(argv=None) -> int:
     parser.add_argument("--k-shot", type=int, default=1)
     parser.add_argument("--full", action="store_true",
                         help="full Conv-4 backbone (default: tiny 2-stage CI shape)")
+    parser.add_argument(
+        "--replicas", type=int, default=1,
+        help="engine replicas behind the router (0 = one per local device); "
+        "the report gains per-replica outcome counts, breaker trips, and "
+        "cache hit rates",
+    )
     parser.add_argument("--max-workers", type=int, default=16)
     parser.add_argument(
         "--access-log-dir", default="logs",
@@ -135,7 +141,7 @@ def main(argv=None) -> int:
         from howtotrainyourmamlpytorch_tpu.serving.server import frontend_from_run_dir
 
         # from_run_dir already points access.jsonl at the run's own logs/
-        frontend = frontend_from_run_dir(args.run_dir)
+        frontend = frontend_from_run_dir(args.run_dir, replicas=args.replicas)
         cfg = frontend.engine.cfg
         n_way = cfg.num_classes_per_set
         k_shot = cfg.num_samples_per_class
@@ -160,6 +166,7 @@ def main(argv=None) -> int:
         frontend = ServingFrontend(
             AdaptationEngine(system, system.init_train_state()),
             access_log_dir=args.access_log_dir or None,
+            replicas=args.replicas,
         )
         model_label = f"vgg{stages}x{filters}"
     img_shape = cfg.image_shape if args.run_dir else (28, 28, 1)
@@ -181,7 +188,8 @@ def main(argv=None) -> int:
 
     log(
         f"loadgen: seed={args.seed} duration={args.duration_s}s "
-        f"stairs={stairs} req/s, {len(schedule)} requests, model {model_label}"
+        f"stairs={stairs} req/s, {len(schedule)} requests, model "
+        f"{model_label}, {len(frontend.pool)} replica(s)"
     )
     run = slo.run_load(
         frontend,
@@ -209,6 +217,7 @@ def main(argv=None) -> int:
         ),
         model=model_label,
         adapt_frac=args.adapt_frac,
+        replicas=len(frontend.pool),
         schedule_digest=slo.schedule_digest(schedule),
     )
     if frontend.access_log is not None and frontend.hub.enabled:

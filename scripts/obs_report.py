@@ -331,6 +331,18 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
         if notable:
             report["notable_events"] = notable
 
+    # padding-waste accounting (ROADMAP 4d): the access log records every
+    # request's true vs bucketed sample count — aggregate the wasted-FLOPs
+    # fraction per (verb, bucket) so bucket-edge tuning has a number
+    access_path = os.path.join(logs_dir, "access.jsonl")
+    if os.path.exists(access_path):
+        access_records, torn_access = _read_jsonl(access_path)
+        if torn_access:
+            report["torn_access_lines"] = torn_access
+        padding = _padding_from_access(access_records)
+        if padding is not None:
+            report["padding"] = padding
+
     xplane_dir = xplane_dir or _profile_dir_from_config(run_dir)
     breakdown = _device_breakdown(xplane_dir)
     if breakdown is not None:
@@ -339,6 +351,39 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
     trace_path = os.path.join(logs_dir, "trace.json")
     report["trace_path"] = trace_path if os.path.exists(trace_path) else None
     return report
+
+
+def _padding_from_access(records: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Per-(verb, bucket) padding waste off access-log lines. FLOPs scale
+    with the PADDED sample count, so a bucket's wasted-FLOPs fraction is
+    ``1 - true_samples / padded_samples`` over the requests it served;
+    lines without both shape fields (cache hits, HTTP-layer failures, older
+    logs) are skipped."""
+    per_bucket: Dict[str, Dict[str, Any]] = {}
+    total_true = total_padded = 0
+    for r in records:
+        bucket, true = r.get("bucket"), r.get("true_size")
+        if not isinstance(bucket, int) or not isinstance(true, int) or bucket <= 0:
+            continue
+        key = f"{r.get('verb')}/{bucket}"
+        row = per_bucket.setdefault(
+            key, {"requests": 0, "true_samples": 0, "padded_samples": 0}
+        )
+        row["requests"] += 1
+        row["true_samples"] += true
+        row["padded_samples"] += bucket
+        total_true += true
+        total_padded += bucket
+    if not per_bucket or not total_padded:
+        return None
+    for row in per_bucket.values():
+        row["waste_frac"] = round(
+            1.0 - row["true_samples"] / row["padded_samples"], 4
+        )
+    return {
+        "by_bucket": dict(sorted(per_bucket.items())),
+        "padding_waste_frac": round(1.0 - total_true / total_padded, 4),
+    }
 
 
 def oneline(report: Dict[str, Any]) -> str:
@@ -357,6 +402,7 @@ def oneline(report: Dict[str, Any]) -> str:
         "prewarm_s": (report.get("prewarm") or {}).get("seconds"),
         "compile_tax_s": compile_tax.get("total_s"),
         "peak_hbm_gib": hbm.get("peak_gib"),
+        "padding_waste": (report.get("padding") or {}).get("padding_waste_frac"),
         "phase_coverage": report.get("phase_coverage"),
         "phase_p50_ms": {k: v.get("p50_ms") for k, v in phases.items()},
         "notable_events": report.get("notable_events"),
@@ -521,6 +567,21 @@ def render_human(report: Dict[str, Any]) -> str:
             lines.append(
                 f"{name[:28]:<28} {p['builds']:>6} {p['lower_s']:>8} "
                 f"{p['compile_s']:>9} {p['cache_hits']:>5}  {flops}"
+            )
+    padding = report.get("padding")
+    if padding:
+        lines.append(
+            f"-- serving padding waste (access.jsonl) -- overall "
+            f"{padding['padding_waste_frac']} of padded FLOPs wasted --"
+        )
+        lines.append(
+            f"{'verb/bucket':<20} {'requests':>8} {'true':>8} {'padded':>8} "
+            f"{'waste':>7}"
+        )
+        for name, row in padding["by_bucket"].items():
+            lines.append(
+                f"{name[:20]:<20} {row['requests']:>8} {row['true_samples']:>8} "
+                f"{row['padded_samples']:>8} {row['waste_frac']:>7}"
             )
     hbm = report.get("hbm")
     if hbm:

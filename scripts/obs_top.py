@@ -133,8 +133,32 @@ def serving_frame(
         "prewarm": (metrics.get("prewarm") or {}).get("status"),
         "access_log_lines": (metrics.get("access_log") or {}).get("lines"),
         "hbm_headroom_frac": _min_headroom(metrics.get("memory")),
+        "padding_waste_frac": (metrics.get("padding") or {}).get(
+            "padding_waste_frac"
+        ),
         "_completed": completed,
     }
+    # fleet payloads (serving/pool.py): the router verdicts + one compact
+    # row per replica — which failure domain is hot, dead, or tripping
+    router = metrics.get("router")
+    if isinstance(router, dict) and router.get("replicas", 1) > 1:
+        frame["router"] = {
+            k: router.get(k)
+            for k in ("replicas", "routable", "routed", "routed_around",
+                      "router_shed")
+        }
+        frame["replicas"] = [
+            {
+                "replica": r.get("replica"),
+                "alive": r.get("alive"),
+                "breaker": (r.get("breaker") or {}).get("state"),
+                "load": r.get("load"),
+                "cache_hit_rate": (r.get("cache") or {}).get("hit_rate"),
+                "ok": (r.get("counts") or {}).get("ok", 0),
+            }
+            for r in metrics.get("replicas") or []
+            if isinstance(r, dict)
+        ]
     return frame
 
 
@@ -211,8 +235,25 @@ def render(frame: Dict[str, Any]) -> str:
         lines.append(
             f"cache    hit_rate {_fmt(frame['cache_hit_rate'])}   "
             f"access_log {_fmt(frame['access_log_lines'])} lines   "
-            f"hbm_headroom {_fmt(frame['hbm_headroom_frac'])}"
+            f"hbm_headroom {_fmt(frame['hbm_headroom_frac'])}   "
+            f"pad_waste {_fmt(frame.get('padding_waste_frac'))}"
         )
+        router = frame.get("router")
+        if router:
+            lines.append(
+                f"router   {_fmt(router['routable'])}/{_fmt(router['replicas'])} "
+                f"routable   routed {_fmt(router['routed'])}   "
+                f"around {_fmt(router['routed_around'])}   "
+                f"429 {_fmt(router['router_shed'])}"
+            )
+            for r in frame.get("replicas") or []:
+                lines.append(
+                    f"  r{_fmt(r['replica'])} "
+                    f"{'alive' if r['alive'] else 'DEAD '}  "
+                    f"breaker {_fmt(r['breaker'])}  load {_fmt(r['load'])}  "
+                    f"ok {_fmt(r['ok'])}  "
+                    f"cache_hit {_fmt(r['cache_hit_rate'])}"
+                )
         for phase, stats in sorted((frame.get("latency") or {}).items()):
             lines.append(
                 f"  {phase:<14} p50 {_fmt(stats['p50_ms'])} ms   "

@@ -44,17 +44,19 @@ from howtotrainyourmamlpytorch_tpu.serving.engine import AdaptationEngine  # noq
 
 
 def build_frontend(
-    run_dir: str, checkpoint: str = "best", overrides=None, system=None
+    run_dir: str, checkpoint: str = "best", overrides=None, system=None,
+    replicas=None,
 ) -> ServingFrontend:
     """``system`` overrides the MAMLSystem built from the run's config — for
     callers whose checkpoint was trained with a hand-built model the config
-    alone cannot reconstruct (e.g. shrunken test backbones)."""
+    alone cannot reconstruct (e.g. shrunken test backbones). ``replicas``
+    overrides ``serving.replicas`` (0 = one per local device)."""
     cfg = load_config(os.path.join(run_dir, "config.yaml"), overrides or [])
     engine = AdaptationEngine.from_run_dir(run_dir, checkpoint, cfg=cfg, system=system)
     # access.jsonl lands in the run's logs/ next to telemetry.jsonl so
     # scripts/trace_merge.py finds the pair together
     return ServingFrontend(
-        engine, access_log_dir=os.path.join(run_dir, "logs")
+        engine, access_log_dir=os.path.join(run_dir, "logs"), replicas=replicas
     )
 
 
@@ -66,11 +68,16 @@ def main(argv=None) -> int:
     parser.add_argument("--host", default=None, help="bind host (default: config serving.host)")
     parser.add_argument("--port", type=int, default=None,
                         help="bind port (default: config serving.port)")
+    parser.add_argument("--replicas", type=int, default=None,
+                        help="engine replicas behind the router "
+                        "(default: config serving.replicas; 0 = one per device)")
     parser.add_argument("overrides", nargs="*", default=[],
                         help="config overrides, key=value dotted paths")
     args = parser.parse_args(argv)
 
-    frontend = build_frontend(args.run_dir, args.checkpoint, args.overrides)
+    frontend = build_frontend(
+        args.run_dir, args.checkpoint, args.overrides, replicas=args.replicas
+    )
     # AOT prewarm (Config.aot): the frontend is already compiling the full
     # (bucket x batch-bucket) grid; /healthz answers 503 "warming" until it
     # finishes, and the frontend prints "serving prewarm: warm in <s>s"

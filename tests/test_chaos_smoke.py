@@ -3,7 +3,7 @@
 The fast smoke runs a seeded in-process slice of the campaign — every
 invariant checked, subprocess episodes (rc=76 wedge, device-shrink) excluded
 for speed since tests/test_wedge_watchdog.py drills those bit-for-bit. The
-full soak (``-m slow``) runs ``scripts/chaos_soak.py --episodes 8 --seed 0``
+full soak (``-m slow``) runs ``scripts/chaos_soak.py --episodes 12 --seed 0``
 end to end and pins the one-JSON-line CLI contract."""
 
 import json
@@ -73,14 +73,14 @@ def test_chaos_smoke_campaign_all_invariants_green(toy_dataset, tmp_path):
 
 @pytest.mark.slow
 def test_full_chaos_soak_cli(tmp_path):
-    """The acceptance command: ``python scripts/chaos_soak.py --episodes 11
-    --seed 0`` (one full menu pass, including the ISSUE 6 grow-back and
-    SIGTERM-during-async-save episodes) reports every invariant green in
-    ONE JSON line, rc 0."""
+    """The acceptance command: ``python scripts/chaos_soak.py --episodes 12
+    --seed 0`` (one full menu pass, including the ISSUE 6 grow-back /
+    SIGTERM-during-async-save episodes and the ISSUE 11 replica-death
+    episode) reports every invariant green in ONE JSON line, rc 0."""
     proc = subprocess.run(
         [
             sys.executable, "scripts/chaos_soak.py",
-            "--episodes", "11", "--seed", "0",
+            "--episodes", "12", "--seed", "0",
             "--work-dir", str(tmp_path),
         ],
         cwd=REPO,
@@ -93,7 +93,9 @@ def test_full_chaos_soak_cli(tmp_path):
     assert len(lines) == 1, lines
     verdict = json.loads(lines[0])
     assert verdict["ok"] is True
-    assert verdict["episodes"] == 11
+    assert verdict["episodes"] == 12
     assert verdict["violations"] == []
     kinds = {r["kind"] for r in verdict["episode_results"]}
-    assert {"device-grow-resume", "sigterm-during-async-save"} <= kinds
+    assert {
+        "device-grow-resume", "sigterm-during-async-save", "serve-replica-death"
+    } <= kinds

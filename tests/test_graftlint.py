@@ -735,6 +735,31 @@ def test_self_gate_covers_perf_obs_paths_explicitly():
     )
 
 
+def test_self_gate_covers_fleet_serving_paths_explicitly():
+    """The serving fleet layer (ISSUE 11) sits inside the self-gate on its
+    own terms: the router and pool hold state shared across every HTTP
+    handler thread (GL201 territory — routed counters, replica liveness,
+    batcher stats) and the replica dispatch waits on futures (GL202
+    territory) — zero unsuppressed findings even if the top-level path
+    list is ever restructured."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join("howtotrainyourmamlpytorch_tpu", "serving", "pool.py"),
+                os.path.join("howtotrainyourmamlpytorch_tpu", "serving", "router.py"),
+                os.path.join("howtotrainyourmamlpytorch_tpu", "serving", "batcher.py"),
+                os.path.join("howtotrainyourmamlpytorch_tpu", "serving", "server.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in fleet-serving paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_covers_aot_paths_explicitly():
     """The AOT prewarm subsystem (ISSUE 8) sits inside the self-gate on its
     own terms: the warm pool is threaded (GL201/GL202 territory — bounded

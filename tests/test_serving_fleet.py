@@ -1,0 +1,480 @@
+"""Fleet serving (ISSUE 11): continuous batching, the affinity router, and
+the replicated engine pool.
+
+The acceptance drill runs on CPU with 2 replicas sharing one engine
+(``EnginePool`` same-device mode — zero extra XLA compiles): mixed
+adapt/predict traffic must be bit-identical to the single-engine path,
+affinity must keep a session's second adapt on the same replica's cache,
+the router must shed at admission (429) and route around a dead replica,
+and the death must resolve through the router/healthz surfaces. The
+scaling headline (loadgen sustained-RPS vs replica count) ships as the
+``@slow`` recipe at the bottom.
+"""
+
+import json
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import Config, ServingConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+from howtotrainyourmamlpytorch_tpu.observability.context import new_request_context
+from howtotrainyourmamlpytorch_tpu.resilience.faults import FaultInjector
+from howtotrainyourmamlpytorch_tpu.serving import (
+    AdaptationEngine,
+    MicroBatcher,
+    NoRoutableReplicaError,
+    Router,
+    ServiceUnavailableError,
+    ServingFrontend,
+    UnknownAdaptationError,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_IMG = (28, 28, 1)
+
+
+# ---------------------------------------------------------------------------
+# continuous batching (satellite): no jax, gated flushes, deterministic
+# ---------------------------------------------------------------------------
+
+
+class _GatedFlush:
+    """flush_fn whose completion the test controls: ``entered`` signals a
+    flush picked up (with its size), ``permits`` releases it."""
+
+    def __init__(self):
+        self.entered = queue.Queue()
+        self.permits = queue.Queue()
+        self.sizes = []
+
+    def __call__(self, bucket, payloads):
+        self.sizes.append(len(payloads))
+        self.entered.put(len(payloads))
+        self.permits.get(timeout=5)
+        return payloads
+
+
+def test_continuous_batching_grows_flushes_toward_max_batch():
+    """A burst arriving while a flush is in flight joins the NEXT flush the
+    moment the worker frees — sizes grow toward max_batch instead of
+    deadline-paced singletons — and each request's flush_batch /
+    queue_wait_s stamps describe the flush it actually rode."""
+    gate = _GatedFlush()
+    b = MicroBatcher(gate, max_batch=4, deadline_ms=5, name="t", continuous=True)
+    try:
+        ctx0 = new_request_context()
+        f0 = b.submit("k", 0, ctx=ctx0)
+        gate.entered.get(timeout=5)  # flush 1 in flight (deadline singleton)
+        # the burst: 3 requests queue DURING flush 1
+        ctxs = [new_request_context() for _ in range(3)]
+        futs = [b.submit("k", i + 1, ctx=c) for i, c in enumerate(ctxs)]
+        gate.permits.put(None)  # complete flush 1
+        assert gate.entered.get(timeout=5) == 3  # continuous pickup, no deadline wait
+        # a second, larger wave during flush 2: 6 requests, max_batch 4
+        ctxs2 = [new_request_context() for _ in range(6)]
+        futs2 = [b.submit("k", 10 + i, ctx=c) for i, c in enumerate(ctxs2)]
+        gate.permits.put(None)
+        assert gate.entered.get(timeout=5) == 4  # full flush
+        gate.permits.put(None)
+        assert gate.entered.get(timeout=5) == 2  # continuous remainder
+        gate.permits.put(None)
+        assert [f.result(5) for f in [f0] + futs + futs2] == [0, 1, 2, 3] + list(
+            range(10, 16)
+        )
+        assert gate.sizes == [1, 3, 4, 2]
+        stats = b.stats()
+        assert stats["flushes_deadline"] == 1
+        assert stats["flushes_full"] == 1
+        assert stats["flushes_continuous"] == 2
+        # per-request stamps: every context carries the size of ITS flush
+        # and a real queue wait (enqueue -> worker pickup)
+        assert ctx0.flush_batch == 1
+        assert all(c.flush_batch == 3 for c in ctxs)
+        assert sorted(c.flush_batch for c in ctxs2) == [2, 2, 4, 4, 4, 4]
+        assert all(
+            c.queue_wait_s is not None and c.queue_wait_s >= 0.0
+            for c in [ctx0] + ctxs + ctxs2
+        )
+    finally:
+        gate.permits.put(None)
+        b.close()
+
+
+def test_continuous_batching_preserves_deadline_for_stragglers():
+    """An idle worker still holds a lone request for the coalescing window:
+    continuous mode must not turn light-load singletons into zero-wait
+    flushes (the deadline is the burst-coalescing contract)."""
+    gate = _GatedFlush()
+    b = MicroBatcher(gate, max_batch=8, deadline_ms=40, name="t", continuous=True)
+    try:
+        # prime: one flush completes, queue drains to empty
+        f0 = b.submit("k", 0)
+        gate.entered.get(timeout=5)
+        gate.permits.put(None)
+        assert f0.result(5) == 0
+        # straggler at an idle worker: flushed by DEADLINE, not instantly
+        t0 = time.monotonic()
+        f1 = b.submit("k", 1)
+        gate.entered.get(timeout=5)
+        waited = time.monotonic() - t0
+        gate.permits.put(None)
+        assert f1.result(5) == 1
+        assert waited >= 0.03, f"straggler flushed after {waited}s (< deadline)"
+        assert b.stats()["flushes_deadline"] == 2
+        assert b.stats()["flushes_continuous"] == 0
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# router units: rendezvous affinity, remap-on-death, admission (no jax)
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplica:
+    def __init__(self, index):
+        self.index = index
+        self.alive = True
+        self.queued = 0
+
+    def routable(self):
+        return self.alive
+
+    def load(self):
+        return self.queued
+
+
+def test_router_rendezvous_affinity_and_minimal_remap():
+    replicas = [_FakeReplica(i) for i in range(3)]
+    router = Router(replicas)
+    keys = [f"digest{i:03d}" for i in range(240)]
+    owners = {k: router.route(k).index for k in keys}
+    # every replica owns a share, and routing is deterministic
+    assert set(owners.values()) == {0, 1, 2}
+    assert all(router.route(k).index == owners[k] for k in keys)
+    # killing replica 1 remaps ONLY its keys (the consistent-hashing
+    # property: no global reshuffle)
+    replicas[1].alive = False
+    remapped = {k: router.route(k).index for k in keys}
+    assert all(remapped[k] == owners[k] for k in keys if owners[k] != 1)
+    assert all(remapped[k] != 1 for k in keys)
+    assert router.stats()["routed_around"] >= sum(
+        1 for v in owners.values() if v == 1
+    )
+    # recovery: the displaced keys come home
+    replicas[1].alive = True
+    assert all(router.route(k).index == owners[k] for k in keys)
+
+
+def test_router_admission_shed_429_and_full_outage_503():
+    replicas = [_FakeReplica(0), _FakeReplica(1)]
+    router = Router(replicas, max_queued_per_replica=2)
+    target = router.route("session-a")
+    target.queued = 2
+    with pytest.raises(ServiceUnavailableError) as exc_info:
+        router.admit(target)
+    assert exc_info.value.status == 429
+    assert exc_info.value.retry_after_s > 0
+    assert router.stats()["router_shed"] == 1
+    # under the bound: admitted
+    target.queued = 1
+    router.admit(target)
+    # whole-fleet outage: distinct error type, 503
+    for r in replicas:
+        r.alive = False
+    with pytest.raises(NoRoutableReplicaError) as exc_info:
+        router.route("session-a")
+    assert exc_info.value.status == 503
+
+
+# ---------------------------------------------------------------------------
+# the pool drill (acceptance): 2 replicas on CPU, shared engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_setup():
+    cfg = Config(
+        num_classes_per_set=5,
+        num_samples_per_class=2,
+        num_target_samples=3,
+        batch_size=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        serving=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=4
+        ),
+    )
+    system = MAMLSystem(
+        cfg, model=build_vgg(_IMG, 5, num_stages=2, cnn_num_filters=4)
+    )
+    engine = AdaptationEngine(system, system.init_train_state())
+    yield cfg, engine
+
+
+def _episode(seed):
+    b = synthetic_batch(1, 5, 2, 3, _IMG, seed=seed)
+    return (
+        b["x_support"][0],
+        b["y_support"][0],
+        b["x_target"][0].reshape((-1,) + _IMG),
+    )
+
+
+def test_clone_for_device_parity(fleet_setup):
+    """The multi-device pool path: an engine cloned onto another (forced
+    host) device serves bit-identical predictions through its own compiled
+    program, with its state committed to that device."""
+    import jax
+
+    _, engine = fleet_setup
+    devices = jax.local_devices()
+    if len(devices) < 2:
+        pytest.skip("needs >= 2 (forced host) devices")
+    clone = engine.clone_for_device(devices[1], 1)
+    assert clone.ledger_tag == "@r1"
+    assert jax.tree.leaves(clone.state.params)[0].devices() == {devices[1]}
+    x_s, y_s, x_q = _episode(2)
+    fw = engine.adapt(x_s, y_s)
+    np.testing.assert_array_equal(
+        np.asarray(engine.predict(fw, x_q)), np.asarray(clone.predict(fw, x_q))
+    )
+
+
+def test_pool_shares_one_engine_per_device(fleet_setup):
+    """More replicas than devices: every replica landing on an
+    already-engined device reuses its engine (jit caches + committed
+    state) — one clone per device, never one per replica. The CPU pin is
+    bypassed by faking a non-cpu backend over the forced host devices."""
+    import jax
+    from unittest import mock
+
+    from howtotrainyourmamlpytorch_tpu.config import ResilienceConfig
+    from howtotrainyourmamlpytorch_tpu.serving import EnginePool, EventCounters
+
+    cfg, engine = fleet_setup
+    if len(jax.local_devices()) < 2:
+        pytest.skip("needs >= 2 (forced host) devices")
+    with mock.patch.object(jax, "default_backend", return_value="tpu"):
+        pool = EnginePool.build(
+            engine, 4, cfg.serving, ResilienceConfig(), EventCounters()
+        )
+    try:
+        n_dev = len(jax.local_devices())
+        engines = [r.engine for r in pool.replicas]
+        assert engines[0] is engine
+        assert engines[n_dev % 4] is engine  # wraps back onto device 0
+        assert len(pool.engines()) == min(4, n_dev)
+        for k, e in enumerate(engines):
+            assert e is engines[k % n_dev]  # one engine per device, shared
+    finally:
+        pool.close()
+
+
+def test_pool_drill_parity_affinity_death(fleet_setup):
+    """THE acceptance drill: a 2-replica fleet behind the router serves a
+    mixed adapt/predict load bit-identically to the single-engine path;
+    the same session's second adapt hits the same replica's cache; a
+    killed replica is routed around with the fleet still serving and the
+    displaced session answered honestly (404-class, never stale)."""
+    cfg, engine = fleet_setup
+    single = ServingFrontend(engine, replicas=1)
+    fleet = ServingFrontend(engine, replicas=2)
+    try:
+        assert len(fleet.pool) == 2
+        # CPU correctness mode: same-device replicas share the engine (and
+        # its compiled programs), separate batchers/breakers/caches
+        assert fleet.pool.replicas[0].engine is fleet.pool.replicas[1].engine
+        assert (
+            fleet.pool.replicas[0].cache is not fleet.pool.replicas[1].cache
+        )
+
+        # -- mixed load, bit-identical to the single-engine path --------
+        sessions = {}
+        for seed in (3, 4, 5):
+            x_s, y_s, x_q = _episode(seed)
+            info_single = single.adapt(x_s, y_s)
+            info_fleet = fleet.adapt(x_s, y_s)
+            assert info_fleet["adaptation_id"] == info_single["adaptation_id"]
+            p_single = single.predict(info_single["adaptation_id"], x_q)
+            p_fleet = fleet.predict(info_fleet["adaptation_id"], x_q)
+            np.testing.assert_array_equal(
+                np.asarray(p_single), np.asarray(p_fleet)
+            )
+            sessions[seed] = (info_fleet["adaptation_id"], x_q, p_fleet)
+
+        # -- affinity: a session's second adapt is a cache hit on the SAME
+        # replica; the other replica's cache never saw it ----------------
+        x_s, y_s, _ = _episode(3)
+        again = fleet.adapt(x_s, y_s)
+        assert again["cached"] is True
+        owner = fleet.router.route(sessions[3][0]).index
+        other = 1 - owner
+        assert fleet.pool.replicas[owner].cache.stats()["hits"] >= 1
+        owned = {
+            seed: fleet.router.route(aid).index
+            for seed, (aid, _, _) in sessions.items()
+        }
+        # per-replica cache entries match the sessions rendezvous-assigned
+        for idx in (0, 1):
+            assert fleet.pool.replicas[idx].cache.stats()["entries"] == sum(
+                1 for o in owned.values() if o == idx
+            )
+
+        # -- kill the owner mid-fleet: routed around, honest failover ----
+        fleet.kill_replica(owner, reason="drill")
+        routed_at_death = fleet.router.stats()["routed"][owner]
+        aid, x_q, p_before = sessions[3]
+        with pytest.raises(UnknownAdaptationError):
+            fleet.predict(aid, x_q)  # displaced session: 404, never stale
+        re_adapt = fleet.adapt(x_s, y_s)  # fleet keeps serving
+        assert re_adapt["cached"] is False
+        p_after = fleet.predict(re_adapt["adaptation_id"], x_q)
+        np.testing.assert_array_equal(np.asarray(p_before), np.asarray(p_after))
+        stats = fleet.router.stats()
+        assert stats["routed"][owner] == routed_at_death  # no new routes
+        assert stats["routed_around"] >= 1
+        assert stats["routable"] == 1
+        health = fleet.healthz()
+        assert health["status"] == "degraded"
+        assert health["routable"] == 1
+        assert f"replica_dead:r{owner}" in health["degraded"]
+        # the surviving replica now holds the re-adapted session
+        assert fleet.pool.replicas[other].cache.stats()["entries"] >= 1
+
+        # -- /metrics: router + per-replica blocks, JSON-serializable ----
+        metrics = fleet.metrics()
+        json.dumps(metrics)
+        assert metrics["router"]["replicas"] == 2
+        assert metrics["replicas"][owner]["alive"] is False
+        assert metrics["replicas"][other]["alive"] is True
+        assert metrics["cache"]["hits"] >= 1  # fleet aggregate schema
+    finally:
+        single.close()
+        fleet.close()
+
+
+def test_fleet_router_admission_sheds_before_replica_queue(fleet_setup):
+    """Admission control end to end: with the routed replica's worker held
+    busy (injected dispatch delay) and an admission bound of 1, concurrent
+    predicts shed at the ROUTER with 429 before queueing at the replica."""
+    cfg, engine = fleet_setup
+    inj = FaultInjector.from_specs(
+        ["serving.dispatch=delay:delay_s=0.4,p=1.0"], include_env=False
+    )
+    old_injector = engine.injector
+    engine.injector = inj
+    frontend = ServingFrontend(
+        engine,
+        serving_cfg=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=1,
+            router_max_queued_per_replica=1,
+        ),
+        replicas=2,
+    )
+    try:
+        x_s, y_s, x_q = _episode(8)
+        info = frontend.adapt(x_s, y_s)
+        outcomes = []
+        lock = threading.Lock()
+
+        def one():
+            try:
+                frontend.predict(info["adaptation_id"], x_q)
+                verdict = "ok"
+            except ServiceUnavailableError as exc:
+                verdict = f"shed{exc.status}"
+            with lock:
+                outcomes.append(verdict)
+
+        threads = [threading.Thread(target=one) for _ in range(4)]
+        threads[0].start()
+        time.sleep(0.1)  # let the first predict occupy the replica's worker
+        for t in threads[1:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert "shed429" in outcomes, outcomes
+        assert "ok" in outcomes, outcomes
+        assert frontend.router.stats()["router_shed"] >= 1
+    finally:
+        engine.injector = old_injector
+        frontend.close()
+
+
+def test_padding_waste_accounting(fleet_setup):
+    """ROADMAP 4d: the wasted-FLOPs fraction is a tracked number — support
+    10 padded to bucket 16 and query 15 padded to 16 must land in the
+    /metrics padding block, the gauge, and the per-request true_size."""
+    cfg, engine = fleet_setup
+    frontend = ServingFrontend(engine, replicas=1)
+    try:
+        b = synthetic_batch(1, 5, 2, 3, _IMG, seed=21)
+        x_s, y_s = b["x_support"][0], b["y_support"][0]  # support 10
+        x_q = b["x_target"][0].reshape((-1,) + _IMG)  # query 15
+        ctx = new_request_context()
+        info = frontend.adapt(x_s, y_s, ctx=ctx)
+        assert ctx.true_size == 10 and ctx.bucket == 16
+        frontend.predict(info["adaptation_id"], x_q)
+        padding = frontend.metrics()["padding"]
+        assert padding["adapt"]["true_samples"] == 10
+        assert padding["adapt"]["padded_samples"] == 16
+        assert padding["adapt"]["padding_waste_frac"] == 0.375
+        assert padding["predict"]["true_samples"] == 15
+        assert padding["predict"]["padding_waste_frac"] == pytest.approx(
+            1 - 15 / 16, abs=1e-4
+        )
+        assert padding["padding_waste_frac"] == pytest.approx(
+            1 - 25 / 32, abs=1e-4
+        )
+        assert frontend.hub.registry.gauge("serving.padding_waste_frac") is not None
+        # a cache hit pads nothing: totals unchanged
+        frontend.adapt(x_s, y_s)
+        assert frontend.metrics()["padding"]["adapt"]["true_samples"] == 10
+    finally:
+        frontend.close()
+
+
+# ---------------------------------------------------------------------------
+# the scaling headline: loadgen sustained-RPS vs replica count (@slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_loadgen_fleet_scaling_headline(tmp_path):
+    """The bench recipe: ``loadgen.py --replicas 2`` produces the one-line
+    SLO report with ``replicas``/``per_replica`` (outcome counts, breaker
+    trips, cache hit rates) — on a multi-device host sustained RPS scales
+    ~linearly with replica count; on this 1-core CPU box the contract
+    fields are the assertion."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [
+            sys.executable, "scripts/loadgen.py",
+            "--seed", "0", "--duration-s", "6", "--stairs", "2,4",
+            "--replicas", "2", "--slo-p99-ms", "30000",
+            "--access-log-dir", str(tmp_path),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1200,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["replicas"] == 2
+    assert report["metric"].startswith("serving_slo_sustained_rps")
+    assert len(report["per_replica"]) == 2
+    for row in report["per_replica"]:
+        assert "breaker_opens" in row and "cache_hit_rate" in row
+    assert report["router"]["replicas"] == 2
