@@ -72,6 +72,21 @@ def _precision_overrides(knob: str) -> dict:
     raise ValueError(
         f"BENCH_PRECISION must be '', 'legacy', 'f32' or 'bf16', got {knob!r}"
     )
+
+
+def _remat_overrides(knob: str) -> dict:
+    """Config kwargs for the BENCH_REMAT A/B knob (ISSUE 12): ``""`` keeps
+    the flagship recipe exactly as before (``remat_inner_steps=False`` —
+    resolved policy "none"); any explicit policy name maps onto
+    ``Config.remat_policy`` so one armed chip session can price the whole
+    remat dial (peak program bytes vs compile/step seconds) off the same
+    queue. Valid names are ``config.REMAT_POLICIES`` — validation happens
+    at Config construction, not here."""
+    if knob == "":
+        return {"remat_inner_steps": False}
+    return {"remat_inner_steps": False, "remat_policy": knob}
+
+
 STARTUP_TIMEOUT_S = float(os.environ.get("BENCH_STARTUP_TIMEOUT_S", 90.0))
 # The axon tunnel wedges for minutes-to-hours at a time (server-side). A
 # single in-process init attempt cannot be retried (backend init happens once
@@ -382,16 +397,23 @@ def main():
     # BENCH_PRECISION=f32|bf16|legacy A/Bs the mixed-precision inner loop
     # (ops/precision.py) against full f32 and the legacy per-forward cast
     # in one armed session; the default keeps the recipe unchanged.
+    # BENCH_REMAT=none|full|dots_saveable|... A/Bs the inner-step remat
+    # policy (peak program bytes vs recompute/compile seconds) on the same
+    # flagship program; the default keeps the recipe's remat-off exactly.
     cfg = Config(
-        remat_inner_steps=False,
         matmul_precision=os.environ.get("BENCH_MATMUL_PRECISION", "default"),
         conv_via_patches=os.environ.get("BENCH_CONV_VIA_PATCHES", "0") == "1",
         **_precision_overrides(os.environ.get("BENCH_PRECISION", "")),
+        **_remat_overrides(os.environ.get("BENCH_REMAT", "")),
     )
     system = MAMLSystem(cfg)
-    # program-variant marker, same contract as matmul_precision above: the
-    # resolved policy name ("legacy_bf16" | "f32" | "bf16_inner")
-    wd.update(precision=system.precision.name)
+    # program-variant markers, same contract as matmul_precision above: the
+    # resolved precision policy name ("legacy_bf16" | "f32" | "bf16_inner")
+    # and the resolved remat policy
+    wd.update(
+        precision=system.precision.name,
+        remat_policy=cfg.resolved_remat_policy,
+    )
     # collector-only compile ledger: every XLA compile this process pays is
     # timed and attributed, so the JSON line's `prewarm` breakdown (compile
     # tax: programs / seconds / persistent-cache hits) is a tracked number
@@ -692,6 +714,12 @@ def main():
             "seconds": ledger_summary["total_s"],
             "cache_hits": ledger_summary["cache_hits"],
         },
+        # program-memory axes (ISSUE 12): the biggest compiled program's
+        # peak bytes and its in-place (donated/aliased) bytes off the
+        # ledger's memory_analysis columns — null where the backend hides
+        # the analysis, like every other cost field
+        peak_program_bytes=ledger_summary.get("peak_program_bytes"),
+        donated_bytes=ledger_summary.get("donated_bytes"),
     )
 
     wd.update(

@@ -69,12 +69,28 @@ def main(argv=None) -> int:
     if knob not in ("", "legacy", "f32", "bf16"):
         print(f"bench_serving: bad BENCH_PRECISION {knob!r}", file=sys.stderr)
         return 2
+    # BENCH_REMAT, same contract as bench.py: "" keeps the recipe (the
+    # serving default remat_inner_steps=True -> "full" on the adapt
+    # programs), a policy name A/Bs the adapt rollout's remat dial.
+    # Validated here like BENCH_PRECISION above: a typo'd arm exits the
+    # clean rc-2 usage contract, not a mid-main Config traceback.
+    from howtotrainyourmamlpytorch_tpu.config import REMAT_POLICIES
+
+    remat_knob = os.environ.get("BENCH_REMAT", "")
+    if remat_knob not in REMAT_POLICIES:
+        print(
+            f"bench_serving: bad BENCH_REMAT {remat_knob!r} "
+            f"(valid: {sorted(p for p in REMAT_POLICIES if p)})",
+            file=sys.stderr,
+        )
+        return 2
     cfg = Config(
         num_classes_per_set=args.n_way,
         num_samples_per_class=args.k_shot,
         num_target_samples=max(args.n_query // args.n_way, 1),
         compute_dtype="bfloat16" if knob == "legacy" else "float32",
         precision={"enabled": knob == "bf16"},
+        remat_policy=remat_knob,
         serving=ServingConfig(
             support_buckets=[support], query_buckets=[args.n_query],
             max_batch_size=args.batch,
@@ -190,6 +206,11 @@ def main(argv=None) -> int:
     # ledger totals; mfu null-with-reason off-chip like bench.py
     summary = ledger.summary()
     result["compile_tax_s"] = summary["total_s"]
+    # program-memory axes (ISSUE 12), same contract as bench.py: resolved
+    # remat policy + biggest program's peak/donated bytes off the ledger
+    result["remat_policy"] = cfg.resolved_remat_policy
+    result["peak_program_bytes"] = summary.get("peak_program_bytes")
+    result["donated_bytes"] = summary.get("donated_bytes")
     # process start -> first served request, plus the prewarm breakdown —
     # the replica-spawn tax as tracked numbers
     result["cold_start_s"] = cold_start_s

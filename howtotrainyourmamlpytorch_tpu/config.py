@@ -45,6 +45,24 @@ INNER_OPTIM_PRESETS: Dict[str, InnerOptimConfig] = {
     "adam": InnerOptimConfig(kind="adam", lr=0.1, beta1=0.5, beta2=0.5),
 }
 
+# The valid Config.remat_policy spellings, "" = derive from the legacy
+# remat_inner_steps boolean. Kept as a literal here (config.py stays
+# jax-free); core/maml.py::apply_remat_policy owns the mapping onto
+# jax.checkpoint(policy=...). jax's ``everything_saveable`` is deliberately
+# NOT offered: measured on jax 0.4.37 it changes the PRIMAL loss under grad
+# for this scanned second-order program family (toy meta-step: loss delta
+# 1.7e-3, meta-grad cosine 0.913 vs every other policy's bitwise/1e-8
+# agreement) — a remat policy that changes math is a correctness bug, and
+# its A/B role (price the checkpoint wrapper itself) is covered by
+# comparing "none" against the saveable policies.
+REMAT_POLICIES = (
+    "",
+    "none",
+    "full",
+    "dots_saveable",
+    "dots_with_no_batch_dims_saveable",
+)
+
 DATASET_PRESETS: Dict[str, DatasetConfig] = {
     "omniglot": DatasetConfig(name="omniglot_dataset", path="datasets/omniglot_dataset"),
     "imagenet": DatasetConfig(
@@ -507,6 +525,11 @@ class Config:
                 f"matmul_precision must be 'default', 'high' or 'highest', "
                 f"got {self.matmul_precision!r}"
             )
+        if self.remat_policy not in REMAT_POLICIES:
+            raise ValueError(
+                f"remat_policy must be one of {sorted(REMAT_POLICIES)} "
+                f"('' derives from remat_inner_steps), got {self.remat_policy!r}"
+            )
         if self.train_steps_per_dispatch < 1:
             raise ValueError(
                 f"train_steps_per_dispatch must be >= 1, "
@@ -612,6 +635,29 @@ class Config:
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     compute_dtype: str = "float32"  # or "bfloat16" for MXU-friendly compute
     remat_inner_steps: bool = True  # jax.checkpoint per inner step (SURVEY §5.7)
+    # Rematerialization POLICY for the scanned inner step (core/maml.py
+    # ``_adapt_loop`` and the MSL ``_rollout`` branch) — the graded dial
+    # between the all-or-nothing extremes the boolean above offers:
+    #   ""                    derive from remat_inner_steps (True -> "full",
+    #                         False -> "none"): bit-identical legacy behavior
+    #   "none"                no jax.checkpoint — save every intermediate
+    #                         (fastest step, highest peak program bytes)
+    #   "full"                jax.checkpoint with the default nothing_saveable
+    #                         policy — recompute everything (the legacy True)
+    #   "dots_saveable"       save dot/conv outputs, recompute the cheap
+    #                         elementwise chain (usually the sweet spot: most
+    #                         of the memory win at a fraction of full's
+    #                         recompute+compile cost)
+    #   "dots_with_no_batch_dims_saveable"
+    #                         like dots_saveable but batched GEMMs (the
+    #                         task-vmapped patches convs) are recomputed too
+    # (jax's everything_saveable is deliberately rejected — see the
+    # REMAT_POLICIES note: it changes the primal under grad on this jax.)
+    # Each compiled program's argument/output/temp/peak bytes land in the
+    # compile ledger (observability/compile_ledger.py ``memory`` column), so
+    # every policy choice has a bytes-and-seconds price tag next to the HBM
+    # watermarks. An explicit value here wins over remat_inner_steps.
+    remat_policy: str = ""
     # Fully unroll the inner-step lax.scan: removes scan sequencing overhead
     # and lets XLA fuse across steps (~+10% meta-steps/s on v5e for the
     # flagship config); costs compile time O(steps). Remat still applies
@@ -671,6 +717,25 @@ class Config:
     # state is ~.5 MB, so donation buys nothing here; turn on only on a
     # platform whose aliasing you have verified with the probe.
     donate_train_state: bool = False
+    # Donate the per-step episode batch buffers (the [B, n_way, k, H, W, C]
+    # support/target tensors) to the compiled train step. Unlike the train
+    # state, the batch is throwaway BY CONSTRUCTION — the loader transfers a
+    # fresh one every step and nothing ever reads a batch after its dispatch
+    # — so this is safe independent of the donate_train_state corruption
+    # verdict above (that bug is the state buffer being read back while
+    # aliased; a batch has no read-back). Cuts the batch's bytes out of the
+    # program's peak (visible as ``alias`` bytes in the ledger's memory
+    # column). Off by default: bit-identical to pre-donation behavior.
+    donate_batch: bool = False
+    # Runtime aliasing self-check gating donate_train_state (the
+    # scripts/donation_probe.py verdict productized,
+    # observability/donation.py::donation_selfcheck): before the first real
+    # step, run a tiny in-process A/B — donate vs no-donate arms over the
+    # same streamed batches — and REFUSE donation (fall back to no-donate,
+    # loudly, with a donation_refused event) when the arms diverge. The
+    # TPU-plugin corruption class (results/r4 DONATION-CORRUPTION) can then
+    # never silently recur. Only consulted when donate_train_state is on.
+    donation_selfcheck: bool = True
     # Force the lax.reduce_window max-pool path (select_and_scatter backward
     # == torch's first-argmax tie subgradient) instead of the faster
     # reshape+max path (even-split tie subgradient). The conventions differ
@@ -724,6 +789,16 @@ class Config:
     @property
     def is_imagenet(self) -> bool:
         return "imagenet" in self.dataset.name
+
+    @property
+    def resolved_remat_policy(self) -> str:
+        """The effective inner-step remat policy: an explicit
+        ``remat_policy`` wins; empty derives from the legacy boolean
+        (``remat_inner_steps=True`` -> "full", False -> "none") so every
+        pre-policy config traces the exact same program it always did."""
+        if self.remat_policy:
+            return self.remat_policy
+        return "full" if self.remat_inner_steps else "none"
 
     @property
     def effective_sets_are_pre_split(self) -> bool:

@@ -65,6 +65,36 @@ def _flatten_task(x):
     return x.reshape((-1,) + x.shape[2:])
 
 
+def apply_remat_policy(step, policy: str):
+    """Wrap one scanned inner-step body per ``Config.resolved_remat_policy``.
+
+    "none" returns ``step`` untouched (save everything); "full" is the
+    legacy all-or-nothing ``jax.checkpoint`` (recompute everything —
+    bit-identical to the old ``remat_inner_steps=True`` wrap); the named
+    policies map onto ``jax.checkpoint_policies`` so XLA saves exactly that
+    class of intermediates (dot/conv outputs under ``dots_saveable``) and
+    recomputes the rest. Every choice is mathematically exact — remat moves
+    bytes against recompute FLOPs, never the result — which the remat-parity
+    tests pin. (jax's ``everything_saveable`` fails exactly that bar on
+    jax 0.4.37 — it changes the primal loss under grad for this scanned
+    second-order family, with or without CSE prevention — so the config
+    rejects it; see ``config.REMAT_POLICIES``.) ``prevent_cse=False``
+    throughout: inside ``lax.scan`` CSE prevention is unnecessary and only
+    blocks fusion."""
+    if policy == "none":
+        return step
+    if policy == "full":
+        return jax.checkpoint(step, prevent_cse=False)
+    named = getattr(jax.checkpoint_policies, policy, None)
+    if named is None:
+        raise ValueError(
+            f"remat policy {policy!r} is not a jax.checkpoint_policies "
+            "member on this jax — config validation and the mapping here "
+            "have drifted"
+        )
+    return jax.checkpoint(step, prevent_cse=False, policy=named)
+
+
 class MAMLSystem:
     """Builds and owns the compiled meta-train / meta-eval programs.
 
@@ -460,8 +490,7 @@ class MAMLSystem:
             p, opt_s = carry
             return inner_update(p, opt_s, hp), None
 
-        if self.cfg.remat_inner_steps:
-            step = jax.checkpoint(step, prevent_cse=False)
+        step = apply_remat_policy(step, self.cfg.resolved_remat_policy)
         (p_final, _), _ = lax.scan(
             step, (params, inner_state), hp_seq, unroll=unroll
         )
@@ -510,9 +539,15 @@ class MAMLSystem:
                 target_loss = cross_entropy(target_logits, y_target)
                 return (p_new, opt_s_new, target_logits), weight * target_loss
 
-            if self.cfg.remat_inner_steps:
-                step = jax.checkpoint(step, prevent_cse=False)
-            logits0 = jnp.zeros((x_target.shape[0], self.cfg.num_classes_per_set))
+            step = apply_remat_policy(step, self.cfg.resolved_remat_policy)
+            # scan-carry logits built in the policy's logits dtype (f32 —
+            # what cast_logits exits in), pinned explicitly so under
+            # bf16_inner the carry dtype is a policy decision, not a
+            # promotion accident
+            logits0 = jnp.zeros(
+                (x_target.shape[0], self.cfg.num_classes_per_set),
+                dtype=self.precision.logits_dtype,
+            )
             (_, _, final_logits), weighted_losses = lax.scan(
                 step, (params, inner_state, logits0), (loss_weights, hp_seq), unroll=unroll
             )
@@ -680,11 +715,24 @@ class MAMLSystem:
             self.cfg.second_order and epoch > self.cfg.first_order_to_second_order_epoch
         )
 
+    def _donate_argnums(self) -> Tuple[int, ...]:
+        """Donated args of the compiled train step/chunk: the TrainState
+        (arg 0, behind the corruption-verdict gate — config.py
+        ``donate_train_state``) and the episode batch buffers (arg 1 —
+        throwaway by construction: the loader transfers a fresh batch every
+        step and nothing reads one after its dispatch)."""
+        donate = []
+        if self.cfg.donate_train_state:
+            donate.append(0)
+        if self.cfg.donate_batch:
+            donate.append(1)
+        return tuple(donate)
+
     def _compiled_train_step(self, second_order: bool, msl_active: bool):
         key = (second_order, msl_active)
         if key not in self._train_step_cache:
             self._note_program(("train",) + key)
-            donate = (0,) if self.cfg.donate_train_state else ()
+            donate = self._donate_argnums()
             self._train_step_cache[key] = self._build_program(
                 ("train",) + key,
                 lambda: jax.jit(
@@ -778,7 +826,7 @@ class MAMLSystem:
         key = (second_order, msl_active)
         if key not in self._train_multi_cache:
             self._note_program(("train_multi",) + key)
-            donate = (0,) if self.cfg.donate_train_state else ()
+            donate = self._donate_argnums()
             self._train_multi_cache[key] = self._build_program(
                 ("train_multi",) + key,
                 lambda: jax.jit(

@@ -678,6 +678,70 @@ class ExperimentRunner:
         )
 
     # ------------------------------------------------------------------
+    # buffer donation (observability/donation.py; Config.donate_*)
+    # ------------------------------------------------------------------
+
+    def _donation_gate(self) -> None:
+        """Run the in-process aliasing A/B and refuse state donation on
+        anything but a clean verdict — including a self-check that itself
+        fails (an uncertifiable backend gets the safe no-donate programs).
+        Runs before the first train program builds, so the refusal changes
+        which programs compile, not which results land."""
+        from ..observability import donation
+
+        self._beat("donation_selfcheck")
+        try:
+            result = donation.donation_selfcheck(self.cfg)
+        except Exception as exc:  # noqa: BLE001 — uncertifiable => no donate
+            result = {
+                "verdict": "selfcheck_failed",
+                "error": f"{type(exc).__name__}: {exc}",
+            }
+        self._beat("donation_selfcheck done")
+        if result["verdict"] == "clean":
+            self.events.append(
+                {"ts": time.time(), "event": "donation_selfcheck", **result}
+            )
+            return
+        self.cfg.donate_train_state = False
+        msg = (
+            f"DONATION REFUSED: aliasing self-check verdict "
+            f"{result['verdict']!r} on backend "
+            f"{result.get('backend', jax.default_backend())} — training "
+            "no-donate (see scripts/donation_probe.py / results/r4)"
+        )
+        print(msg, flush=True)
+        self.events.append(
+            {"ts": time.time(), "event": "donation_refused", **result}
+        )
+        storage.change_json_log_experiment_status(
+            self.logs_dir, self.experiment_name, msg
+        )
+
+    def _note_donation_audit(self) -> None:
+        """One ``donation_audit`` event (+ gauge): per planned train
+        program, donated vs left-on-the-table bytes under the current
+        flags — the host-side half of the ledger's per-program ``alias``
+        bytes. Contained: an audit failure costs the event, never the run."""
+        from ..observability import donation
+
+        try:
+            audit = donation.donation_audit(self.cfg, self.state)
+        except Exception as exc:  # noqa: BLE001 — bookkeeping only
+            print(f"warning: donation audit unavailable: {exc!r}", flush=True)
+            return
+        self.events.append({"ts": time.time(), "event": "donation_audit", **audit})
+        if self.hub.enabled:
+            self.hub.registry.set_gauge(
+                "donation",
+                {
+                    "flags": audit["flags"],
+                    "donated_bytes": audit["donated_bytes"],
+                    "left_on_table_bytes": audit["left_on_table_bytes"],
+                },
+            )
+
+    # ------------------------------------------------------------------
     # AOT prewarm (compile/aot.py; Config.aot)
     # ------------------------------------------------------------------
 
@@ -1359,6 +1423,16 @@ class ExperimentRunner:
         if cfg.evaluate_on_test_set_only:
             self.load_best()
             return self.evaluate_test()
+
+        # Donation gate (Config.donation_selfcheck; observability/
+        # donation.py): certify state donation on THIS backend with a tiny
+        # in-process A/B BEFORE any donated program compiles — a diverging
+        # arm (the round-4 TPU-plugin corruption signature) refuses
+        # donation instead of silently corrupting the run. Then record the
+        # donation audit (donatable vs donated bytes per planned program).
+        if cfg.donate_train_state and cfg.donation_selfcheck:
+            self._donation_gate()
+        self._note_donation_audit()
 
         # AOT prewarm (Config.aot): the entire planned program set compiles
         # HERE — inside the watchdog scope, before the first step — so the

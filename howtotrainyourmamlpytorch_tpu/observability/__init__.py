@@ -26,6 +26,10 @@ The performance layer (ISSUE 7) builds on those three:
   persistent-cache hit accounting (the AOT/cold-start evidence base);
 - :mod:`memory` — per-device HBM watermarks as a snapshot provider plus a
   latched low-headroom event;
+- :mod:`donation` — the buffer-donation audit table (per planned program:
+  donatable vs donated bytes) and the runtime aliasing self-check gating
+  ``donate_train_state`` (the ``scripts/donation_probe.py`` verdict
+  productized — ISSUE 12);
 - :mod:`slo` — deterministic open-loop load schedules + the SLO report
   (CLI: ``scripts/loadgen.py``).
 
@@ -53,7 +57,14 @@ from .context import (  # noqa: F401
     parse_traceparent,
     read_access_log,
 )
-from .costs import jit_cost, mfu, peak_flops_per_sec, program_cost  # noqa: F401
+from .costs import (  # noqa: F401
+    jit_cost,
+    mfu,
+    peak_flops_per_sec,
+    program_cost,
+    program_memory,
+)
+from .donation import donation_audit, donation_selfcheck  # noqa: F401
 from .memory import MemoryWatermarks, device_memory_stats  # noqa: F401
 from .metrics import MetricsRegistry  # noqa: F401
 from .telemetry import NULL_HUB, TelemetryHub  # noqa: F401

@@ -39,7 +39,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..utils.compcache import active_cache_dir, cache_entry_count
-from .costs import program_cost
+from .costs import program_cost, program_memory
 
 
 def program_name(key: Any) -> str:
@@ -89,6 +89,7 @@ class CompileLedger:
         flops: Optional[float] = None,
         bytes_accessed: Optional[float] = None,
         error: Optional[str] = None,
+        memory: Optional[Dict[str, Any]] = None,
         **extra: Any,
     ) -> Dict[str, Any]:
         """Append one ledger entry (and fold it into the in-process
@@ -106,6 +107,12 @@ class CompileLedger:
             "persistent_cache": persistent_cache,
             "flops": flops,
             "bytes_accessed": bytes_accessed,
+            # per-program memory breakdown (observability/costs.py::
+            # program_memory): argument/output/temp/generated/alias bytes +
+            # the derived peak — null-with-reason where the backend hides
+            # memory_analysis; absent entirely for entries with no compiled
+            # object (guard-seam totals, store hits)
+            "memory": memory,
             "error": error,
         }
         if self.session is not None:
@@ -124,6 +131,8 @@ class CompileLedger:
                     "errors": 0,
                     "flops": None,
                     "bytes_accessed": None,
+                    "peak_bytes": None,
+                    "donated_bytes": None,
                 },
             )
             agg["builds"] += 1
@@ -138,6 +147,11 @@ class CompileLedger:
                 agg["flops"] = flops
             if bytes_accessed is not None:
                 agg["bytes_accessed"] = bytes_accessed
+            if memory is not None:
+                if memory.get("peak_bytes") is not None:
+                    agg["peak_bytes"] = memory["peak_bytes"]
+                if memory.get("alias_bytes") is not None:
+                    agg["donated_bytes"] = memory["alias_bytes"]
         if self._log is not None:
             try:
                 self._log.append(entry)
@@ -157,6 +171,12 @@ class CompileLedger:
         with self._lock:
             programs = {k: dict(v) for k, v in self._programs.items()}
             entries = self._entries
+        peaks = [
+            p["peak_bytes"] for p in programs.values() if p.get("peak_bytes")
+        ]
+        donated = [
+            p["donated_bytes"] for p in programs.values() if p.get("donated_bytes")
+        ]
         return {
             "entries": entries,
             "programs": len(programs),
@@ -165,6 +185,11 @@ class CompileLedger:
             "total_s": round(sum(p["total_s"] for p in programs.values()), 3),
             "cache_hits": sum(p["cache_hits"] for p in programs.values()),
             "errors": sum(p["errors"] for p in programs.values()),
+            # the headline memory numbers: the biggest program's peak bytes
+            # (the one that OOMs first) and its in-place (donated) bytes;
+            # None where no backend exposed memory_analysis
+            "peak_program_bytes": max(peaks) if peaks else None,
+            "donated_bytes": max(donated) if donated else None,
             "by_program": programs,
         }
 
@@ -252,6 +277,7 @@ class LedgerWrapped:
             persistent_cache=cache_info,
             flops=cost.get("flops"),
             bytes_accessed=cost.get("bytes_accessed"),
+            memory=program_memory(compiled),
             **extra,
         )
         return compiled

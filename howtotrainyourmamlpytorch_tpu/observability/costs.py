@@ -148,6 +148,67 @@ def program_cost(lowered_or_compiled: Any, compiled: Any = None) -> Dict[str, An
     }
 
 
+#: ``memory_analysis()`` fields surfaced per compiled program, in the order
+#: the ledger/report tables print them. Attribute names on the XLA
+#: ``CompiledMemoryStats`` are ``<field>_size_in_bytes``.
+MEMORY_FIELDS = ("argument", "output", "temp", "generated_code", "alias")
+
+
+def program_memory(compiled: Any) -> Dict[str, Any]:
+    """Best-effort per-program memory breakdown off XLA's
+    ``Compiled.memory_analysis()``: ``{argument_bytes, output_bytes,
+    temp_bytes, generated_code_bytes, alias_bytes, peak_bytes, error}``.
+    Never raises — same contract as :func:`program_cost` (the PR 7
+    crash-class lesson: merely *accessing* an optional stage attribute can
+    raise inside a plugin); a backend without the analysis degrades to all-
+    None bytes with the reason in ``error``.
+
+    ``peak_bytes`` is the standard program-peak estimate arguments +
+    outputs + temps - aliased (donated inputs overlap outputs, so their
+    bytes are not double-counted) — the per-program number to read against
+    the device-level HBM watermarks. ``alias_bytes`` is the donation win:
+    bytes of input the compiled program updates in place."""
+    nulls: Dict[str, Any] = {f"{f}_bytes": None for f in MEMORY_FIELDS}
+    nulls["peak_bytes"] = None
+    try:
+        attr = getattr(compiled, "memory_analysis", None)
+        if attr is None:
+            return {**nulls, "error": "no memory_analysis attribute"}
+        ma = attr() if callable(attr) else attr
+    except Exception as exc:
+        return {**nulls, "error": f"memory_analysis: {type(exc).__name__}: {exc}"}
+    if ma is None:
+        return {**nulls, "error": "memory_analysis returned None"}
+    out: Dict[str, Any] = {}
+    for f in MEMORY_FIELDS:
+        try:
+            v = getattr(ma, f"{f}_size_in_bytes", None)
+            out[f"{f}_bytes"] = int(v) if v is not None else None
+        except Exception:
+            out[f"{f}_bytes"] = None
+    if all(out[f"{f}_bytes"] is None for f in MEMORY_FIELDS):
+        return {**nulls, "error": f"no usable byte fields on {type(ma).__name__}"}
+    # peak only when ALL THREE components are readable: a partial sum
+    # (temps are usually the dominant term) would silently understate the
+    # headline OOM number — null-with-reason instead, same contract as the
+    # total miss
+    trio = {f: out[f"{f}_bytes"] for f in ("argument", "output", "temp")}
+    missing = sorted(f for f, v in trio.items() if v is None)
+    if missing:
+        out["peak_bytes"] = None
+        out["error"] = (
+            f"partial memory_analysis: missing {'/'.join(missing)} bytes "
+            "(peak withheld rather than understated)"
+        )
+        return out
+    peak = sum(trio.values())
+    if out["alias_bytes"]:
+        peak -= out["alias_bytes"]
+    out["peak_bytes"] = peak
+    out["error"] = None
+    return out
+
+
 def jit_cost(jitted_fn: Callable, *args, **kwargs) -> Dict[str, Any]:
     """Cost of the program ``jitted_fn(*args, **kwargs)`` would run: lowers
     host-side (one trace, no device execution, no extra XLA compile unless

@@ -79,6 +79,14 @@ class PrecisionPolicy(NamedTuple):
 
     # ------------------------------------------------------------------
 
+    @property
+    def logits_dtype(self):
+        """The dtype :meth:`cast_logits` exits in — and therefore the dtype
+        any scan carry holding logits (the MSL per-step-target rollout's
+        ``logits0``) must be built in explicitly, so the carry dtype is
+        pinned by the policy rather than by promotion accident."""
+        return jnp.float32
+
     def cast_forward_inputs(self, params, x):
         """Entry cast of one model forward: params + input batch to the
         compute dtype. Identity (no ops traced) when compute is f32 — and a

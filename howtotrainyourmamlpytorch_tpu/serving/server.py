@@ -390,6 +390,14 @@ class ServingFrontend:
         reg = self.hub.registry
         reg.inc(f"serving.padding.{verb}.true_samples", int(true_size))
         reg.inc(f"serving.padding.{verb}.padded_samples", int(bucket))
+        # per-bucket tallies: the bucket-granular traffic histogram the
+        # auto-tuner (serving/buckets.py::traffic_from_metrics) consumes
+        # when no access log was recorded
+        reg.inc(f"serving.padding.{verb}.bucket.{int(bucket)}.count", 1)
+        reg.inc(
+            f"serving.padding.{verb}.bucket.{int(bucket)}.true_samples",
+            int(true_size),
+        )
         true_total = sum(
             reg.counter(f"serving.padding.{v}.true_samples")
             for v in ("adapt", "predict")
@@ -423,6 +431,20 @@ class ServingFrontend:
         out["padding_waste_frac"] = (
             round(1.0 - true_total / padded_total, 4) if padded_total else None
         )
+        # per-(verb, bucket) request counts + true-sample totals — what
+        # scripts/bucket_tune.py tunes edges from via /metrics
+        by_bucket: Dict[str, Dict[str, Dict[str, int]]] = {}
+        for verb in ("adapt", "predict"):
+            prefix = f"serving.padding.{verb}.bucket."
+            rows: Dict[str, Dict[str, int]] = {}
+            for name, value in reg.counters(prefix).items():  # prefix-stripped
+                bucket_id, _, field = name.partition(".")
+                if field in ("count", "true_samples"):
+                    rows.setdefault(bucket_id, {})[field] = value
+            if rows:
+                by_bucket[verb] = rows
+        if by_bucket:
+            out["by_bucket"] = by_bucket
         return out
 
     def kill_replica(self, index: int, reason: str = "operator") -> None:

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Bucket auto-tuner CLI: recorded traffic in, config overrides out.
+
+Consumes the padding-waste traffic PR 11 started recording —
+``logs/access.jsonl`` true sizes (precise) or a saved ``/metrics`` snapshot's
+``padding.by_bucket`` tallies (bucket-granular) — and solves for the serving
+shape-bucket edges minimizing padded FLOPs under a max-program-count budget
+(``serving/buckets.py``, exact DP). Emits ONE JSON line with the tuned
+edges, the before/after ``padding_waste_frac``, and the dotlist overrides
+(``serving.support_buckets=[...]``) that the engine bucket tables, the
+strict-mode planned sets, and the AOT prewarm grid all derive from::
+
+    python scripts/bucket_tune.py --run-dir exps/<run> [--max-programs 64]
+    python scripts/bucket_tune.py --access-log logs/access.jsonl \
+        [--max-buckets 4] [--keep-max-edge]
+    python scripts/bucket_tune.py --metrics metrics.json
+
+Apply the result by passing the overrides to any entry point that loads the
+config (``scripts/serve.py ... serving.support_buckets=[...]``), or write
+them to a file with ``--write-overrides`` (one per line — xargs-able).
+
+rc 0 = tuned; rc 2 = usage error or no usable traffic. Import-light: no
+jax, no package import — tuning a trace costs milliseconds anywhere.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO_ROOT, "howtotrainyourmamlpytorch_tpu")
+
+
+def _load_by_path(name: str, path: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+buckets = _load_by_path(
+    "htymp_serving_buckets", os.path.join(_PKG, "serving", "buckets.py")
+)
+
+try:
+    exit_codes = _load_by_path("htymp_exit_codes", os.path.join(_PKG, "exit_codes.py"))
+    _RC_OK, _RC_USAGE = exit_codes.OK, exit_codes.USAGE
+except Exception:  # standalone copy of scripts/: the historical literals hold
+    _RC_OK, _RC_USAGE = 0, 2
+
+#: ServingConfig's default bucket tables (config.py), for traffic captured
+#: outside a run dir; pinned against the real dataclass by test.
+DEFAULT_SUPPORT_BUCKETS = [25, 50, 100, 200]
+DEFAULT_QUERY_BUCKETS = [5, 15, 40, 100]
+DEFAULT_MAX_BATCH = 8
+
+
+def _serving_block_from_run_dir(run_dir: str):
+    """current bucket edges + max_batch_size off the run's config.yaml
+    (absent keys keep the dataclass defaults above)."""
+    import yaml  # stdlib-adjacent; never pulls jax
+
+    path = os.path.join(run_dir, "config.yaml")
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    serving = cfg.get("serving") or {}
+    return (
+        sorted(int(b) for b in serving.get("support_buckets", DEFAULT_SUPPORT_BUCKETS)),
+        sorted(int(b) for b in serving.get("query_buckets", DEFAULT_QUERY_BUCKETS)),
+        int(serving.get("max_batch_size", DEFAULT_MAX_BATCH)),
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="tune serving shape buckets from recorded traffic"
+    )
+    parser.add_argument(
+        "--run-dir", help="run directory: logs/access.jsonl + config.yaml"
+    )
+    parser.add_argument("--access-log", help="explicit access.jsonl path")
+    parser.add_argument(
+        "--metrics", help="saved /metrics JSON snapshot (padding.by_bucket)"
+    )
+    parser.add_argument(
+        "--max-buckets", type=int, default=None,
+        help="edge budget per verb (default: the current edge count)",
+    )
+    parser.add_argument(
+        "--max-programs", type=int, default=None,
+        help="TOTAL planned serving-program budget; derives the per-verb "
+        "edge cap from the task-batch bucket count",
+    )
+    parser.add_argument(
+        "--max-batch", type=int, default=None,
+        help="serving.max_batch_size (default: run config, else "
+        f"{DEFAULT_MAX_BATCH}); only used with --max-programs",
+    )
+    parser.add_argument(
+        "--keep-max-edge", action="store_true",
+        help="append the current top edge when the traffic never reached "
+        "it, preserving coverage for unseen large requests",
+    )
+    parser.add_argument(
+        "--write-overrides", metavar="PATH",
+        help="also write the dotlist overrides to PATH, one per line",
+    )
+    args = parser.parse_args(argv)
+
+    if not (args.run_dir or args.access_log or args.metrics):
+        print(
+            json.dumps({"ok": False, "error": "need --run-dir, --access-log or --metrics"})
+        )
+        return _RC_USAGE
+
+    support, query, max_batch = (
+        list(DEFAULT_SUPPORT_BUCKETS), list(DEFAULT_QUERY_BUCKETS), DEFAULT_MAX_BATCH
+    )
+    if args.run_dir:
+        try:
+            support, query, max_batch = _serving_block_from_run_dir(args.run_dir)
+        except OSError as exc:
+            print(json.dumps({"ok": False, "error": f"config.yaml: {exc}"}))
+            return _RC_USAGE
+    if args.max_batch is not None:
+        max_batch = args.max_batch
+
+    histograms = []
+    sources = []
+    access_log = args.access_log or (
+        os.path.join(args.run_dir, "logs", "access.jsonl") if args.run_dir else None
+    )
+    if access_log and os.path.exists(access_log):
+        histograms.append(buckets.traffic_from_access_log(access_log))
+        sources.append(access_log)
+    elif args.access_log:
+        print(json.dumps({"ok": False, "error": f"no such access log: {access_log}"}))
+        return _RC_USAGE
+    if args.metrics:
+        try:
+            with open(args.metrics) as f:
+                histograms.append(buckets.traffic_from_metrics(json.load(f)))
+            sources.append(args.metrics)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(json.dumps({"ok": False, "error": f"metrics snapshot: {exc}"}))
+            return _RC_USAGE
+
+    traffic = {
+        verb: buckets.merge_histograms([h.get(verb, {}) for h in histograms])
+        for verb in ("adapt", "predict")
+    }
+    if not any(traffic.values()):
+        print(
+            json.dumps(
+                {"ok": False, "error": "no usable traffic (no ok-outcome "
+                 "lines with true_size / no by_bucket tallies)",
+                 "sources": sources}
+            )
+        )
+        return _RC_USAGE
+
+    result = buckets.tune(
+        traffic,
+        current_support=support,
+        current_query=query,
+        max_buckets=args.max_buckets,
+        max_programs=args.max_programs,
+        max_batch=max_batch,
+        keep_max_edge=args.keep_max_edge,
+    )
+    if args.write_overrides:
+        with open(args.write_overrides, "w") as f:
+            f.write("".join(line + "\n" for line in result["overrides"]))
+    print(json.dumps({"ok": True, "sources": sources, **result}))
+    return _RC_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,11 @@ divergence on the chip (CPU control is bit-identical because donation is
 ignored there) is the smoking gun for the 20-way collapse's top suspect
 (results/r4/DIAG_20way_r4.md).
 
+The arm runner, comparison, and verdict thresholds live in
+``observability/donation.py`` — the SAME implementation the runtime gate
+(``Config.donation_selfcheck``) runs in-process at startup, so this script
+and the production self-check can never drift apart.
+
 Argv: [n_steps=40] [n_way=20] [k_shot=5] [batch_size=8]
 
 ``selfcheck`` as argv[1] runs the determinism control instead: each arm
@@ -35,90 +40,63 @@ if os.environ.get("JAX_PLATFORMS"):
 
 import dataclasses
 
-import jax.numpy as jnp
-import numpy as np
-
 from howtotrainyourmamlpytorch_tpu.config import Config
 from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
-from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.observability.donation import (
+    compare_arms,
+    param_divergences,
+    run_donation_arm,
+    verdict_from,
+)
 
 
-def run_arm(cfg: Config, n_steps: int, n_batches: int = 16, system: MAMLSystem = None):
-    # selfcheck passes the arm's system in so the re-run reuses its compiled
-    # program instead of burning a second multi-minute on-chip compile
-    system = system or MAMLSystem(cfg)
-    state = system.init_train_state()
-    losses = []
-    for i in range(n_steps):
-        # fresh host->device transfer every step, like the real loader —
-        # the donated previous state's buffers are free for reuse by these
-        # incoming copies, which is the aliasing window under test
-        host = synthetic_batch(
-            cfg.batch_size,
-            cfg.num_classes_per_set,
-            cfg.num_samples_per_class,
-            cfg.num_target_samples,
-            cfg.image_shape,
-            seed=i % n_batches,
-        )
-        batch = {k: jax.device_put(np.asarray(v)) for k, v in host.items()}
-        state, out = system.train_step(state, batch, epoch=0)
-        losses.append(float(out.loss))
-    return losses, jax.device_get(state.params)
-
-
-def _rel_divs(params_a, params_b):
-    """[(path_str, rel ||a-b||/||b||)] per leaf, two same-structure trees."""
-    out = []
-    for (path_a, leaf_a), (_, leaf_b) in zip(
-        jax.tree_util.tree_flatten_with_path(params_a)[0],
-        jax.tree_util.tree_flatten_with_path(params_b)[0],
-    ):
-        a, b = np.asarray(leaf_a, np.float64), np.asarray(leaf_b, np.float64)
-        rel = np.linalg.norm(a - b) / (np.linalg.norm(b) or 1.0)
-        out.append((jax.tree_util.keystr(path_a), rel))
-    return out
-
-
-def _worst_rel(params_a, params_b):
-    return max(rel for _, rel in _rel_divs(params_a, params_b))
-
-
-def selfcheck(argv):
-    n_steps = int(argv[0]) if len(argv) > 0 else 40
-    n_way = int(argv[1]) if len(argv) > 1 else 20
-    k_shot = int(argv[2]) if len(argv) > 2 else 5
-    batch_size = int(argv[3]) if len(argv) > 3 else 8
-    base = Config(
+def _base_config(argv, offset=0):
+    n_steps = int(argv[offset]) if len(argv) > offset else 40
+    n_way = int(argv[offset + 1]) if len(argv) > offset + 1 else 20
+    k_shot = int(argv[offset + 2]) if len(argv) > offset + 2 else 5
+    batch_size = int(argv[offset + 3]) if len(argv) > offset + 3 else 8
+    cfg = Config(
         num_classes_per_set=n_way,
         num_samples_per_class=k_shot,
         batch_size=batch_size,
-        unroll_inner_steps=True,
+        unroll_inner_steps=True,  # the production program family
         remat_inner_steps=False,
     )
+    return n_steps, cfg
+
+
+def selfcheck(argv):
+    n_steps, base = _base_config(argv)
     print(
         f"donation selfcheck: backend={jax.default_backend()} n_steps={n_steps} "
-        f"{n_way}w{k_shot}s b{batch_size}",
+        f"{base.num_classes_per_set}w{base.num_samples_per_class}s "
+        f"b{base.batch_size}",
         flush=True,
     )
     runs = {}
     for donate in (True, False):
         cfg = dataclasses.replace(base, donate_train_state=donate)
+        # re-runs reuse the arm's system so the control costs one compile,
+        # not two multi-minute on-chip ones
         system = MAMLSystem(cfg)
-        runs[donate] = [run_arm(cfg, n_steps, system=system) for _ in range(2)]
+        runs[donate] = [
+            run_donation_arm(cfg, n_steps, system=system) for _ in range(2)
+        ]
         (loss_a, p_a), (loss_b, p_b) = runs[donate]
-        max_loss = max(abs(x - y) for x, y in zip(loss_a, loss_b))
-        rel = _worst_rel(p_a, p_b)
+        cmp = compare_arms(loss_a, p_a, loss_b, p_b)
         # two-signal label like main()'s verdict: a loss-trace deviation is
         # nondeterminism even if the params happen to land back together
-        nondet = rel > 1e-4 or max_loss > 1e-4
+        nondet = cmp["worst_param_rel"] > 1e-4 or cmp["max_loss_dev"] > 1e-4
         print(
-            f"  donate={donate} run-vs-rerun: max |loss dev| = {max_loss:.3e}, "
-            f"worst param rel |d| = {rel:.3e} "
+            f"  donate={donate} run-vs-rerun: max |loss dev| = "
+            f"{cmp['max_loss_dev']:.3e}, worst param rel |d| = "
+            f"{cmp['worst_param_rel']:.3e} "
             f"({'NONDETERMINISTIC' if nondet else 'self-reproducible'})",
             flush=True,
         )
-    cross = _worst_rel(runs[True][0][1], runs[False][0][1])
+    cross = compare_arms(
+        runs[True][0][0], runs[True][0][1], runs[False][0][0], runs[False][0][1]
+    )["worst_param_rel"]
     print(f"  donate-vs-nodonate (run 0): worst param rel |d| = {cross:.3e}", flush=True)
 
 
@@ -126,42 +104,36 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "selfcheck":
         selfcheck(sys.argv[2:])
         return
-    n_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 40
-    n_way = int(sys.argv[2]) if len(sys.argv) > 2 else 20
-    k_shot = int(sys.argv[3]) if len(sys.argv) > 3 else 5
-    batch_size = int(sys.argv[4]) if len(sys.argv) > 4 else 8
-
-    base = Config(
-        num_classes_per_set=n_way,
-        num_samples_per_class=k_shot,
-        batch_size=batch_size,
-        unroll_inner_steps=True,  # the production program family
-        remat_inner_steps=False,
-    )
+    n_steps, base = _base_config(sys.argv, offset=1)
     print(
         f"donation probe: backend={jax.default_backend()} n_steps={n_steps} "
-        f"{n_way}w{k_shot}s b{batch_size}",
+        f"{base.num_classes_per_set}w{base.num_samples_per_class}s "
+        f"b{base.batch_size}",
         flush=True,
     )
-    loss_d, params_d = run_arm(dataclasses.replace(base, donate_train_state=True), n_steps)
-    loss_n, params_n = run_arm(dataclasses.replace(base, donate_train_state=False), n_steps)
-
-    max_loss_dev = max(abs(a - b) for a, b in zip(loss_d, loss_n))
-    first_dev = next(
-        (i for i, (a, b) in enumerate(zip(loss_d, loss_n)) if abs(a - b) > 1e-5), None
+    loss_d, params_d = run_donation_arm(
+        dataclasses.replace(base, donate_train_state=True), n_steps
     )
-    print(f"per-step loss: max |donate - nodonate| = {max_loss_dev:.3e} "
-          f"(first step deviating >1e-5: {first_dev})", flush=True)
+    loss_n, params_n = run_donation_arm(
+        dataclasses.replace(base, donate_train_state=False), n_steps
+    )
 
-    divs = _rel_divs(params_d, params_n)
-    worst_rel = max(rel for _, rel in divs)
-    for path, rel in divs:
+    cmp = compare_arms(loss_d, params_d, loss_n, params_n)
+    print(
+        f"per-step loss: max |donate - nodonate| = {cmp['max_loss_dev']:.3e} "
+        f"(first step deviating >1e-5: {cmp['first_step_deviating']})",
+        flush=True,
+    )
+    for path, rel in param_divergences(params_d, params_n):
         if rel > 1e-4:
             print(f"  DIVERGED {path}: rel |Δ| = {rel:.3e}", flush=True)
-    print(f"final params: worst relative divergence = {worst_rel:.3e}", flush=True)
-    # float-reorder noise between two identical-math programs is ~1e-6 rel;
-    # donation corruption is orders of magnitude beyond it
-    verdict = "DONATION-CORRUPTION" if (worst_rel > 1e-3 or max_loss_dev > 1e-2) else "clean"
+    print(
+        f"final params: worst relative divergence = {cmp['worst_param_rel']:.3e}",
+        flush=True,
+    )
+    verdict = (
+        "DONATION-CORRUPTION" if verdict_from(cmp) == "corruption" else "clean"
+    )
     print(f"verdict: {verdict}", flush=True)
 
 

@@ -146,6 +146,21 @@ def _aggregate_compile_ledger(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
             agg["errors"] += 1
         if e.get("flops"):
             agg["flops"] = e["flops"]
+        # the memory column (ISSUE 12): per-program argument/output/temp/
+        # peak bytes + donated (aliased) bytes off memory_analysis; latest
+        # build wins, like flops
+        mem = e.get("memory") or {}
+        for src, dst in (
+            ("argument_bytes", "argument_bytes"),
+            ("output_bytes", "output_bytes"),
+            ("temp_bytes", "temp_bytes"),
+            ("peak_bytes", "peak_bytes"),
+            ("alias_bytes", "donated_bytes"),
+        ):
+            if mem.get(src) is not None:
+                agg[dst] = mem[src]
+    peaks = [p["peak_bytes"] for p in by.values() if p.get("peak_bytes")]
+    donated = [p["donated_bytes"] for p in by.values() if p.get("donated_bytes")]
     return {
         "entries": len(entries),
         "programs": len(by),
@@ -154,6 +169,8 @@ def _aggregate_compile_ledger(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
         "total_s": round(sum(p["total_s"] for p in by.values()), 3),
         "cache_hits": sum(p["cache_hits"] for p in by.values()),
         "errors": sum(p["errors"] for p in by.values()),
+        "peak_program_bytes": max(peaks) if peaks else None,
+        "donated_bytes": max(donated) if donated else None,
         "by_program": by,
     }
 
@@ -326,10 +343,31 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
             for k, v in counts.items()
             if k in ("nan_step_skipped", "nan_rollback", "nan_abort",
                      "preempted", "wedged", "wedge_checkpoint",
-                     "degraded_mesh", "early_abort")
+                     "degraded_mesh", "early_abort", "donation_refused")
         }
         if notable:
             report["notable_events"] = notable
+        # donation bookkeeping (ISSUE 12): the audit table (donatable vs
+        # donated bytes per planned program) and, when the aliasing
+        # self-check refused donation, its verdict
+        audit = next(
+            (r for r in reversed(event_records)
+             if r.get("event") == "donation_audit"),
+            None,
+        )
+        if audit is not None:
+            report["donation"] = {
+                k: v for k, v in audit.items() if k not in ("ts", "event")
+            }
+        refused = next(
+            (r for r in reversed(event_records)
+             if r.get("event") == "donation_refused"),
+            None,
+        )
+        if refused is not None:
+            report.setdefault("donation", {})["refused"] = {
+                k: v for k, v in refused.items() if k not in ("ts", "event")
+            }
 
     # padding-waste accounting (ROADMAP 4d): the access log records every
     # request's true vs bucketed sample count — aggregate the wasted-FLOPs
@@ -386,6 +424,13 @@ def _padding_from_access(records: List[Dict[str, Any]]) -> Optional[Dict[str, An
     }
 
 
+def _fmt_mib(n: Optional[float]) -> str:
+    """bytes -> MiB with 2 decimals, '-' for unknown."""
+    if n is None:
+        return "-"
+    return f"{n / 2**20:.2f}"
+
+
 def oneline(report: Dict[str, Any]) -> str:
     """One compact JSON line per run for sweep logs."""
     phases = report.get("phases", {})
@@ -401,6 +446,7 @@ def oneline(report: Dict[str, Any]) -> str:
         "cold_start_s": report.get("cold_start_s"),
         "prewarm_s": (report.get("prewarm") or {}).get("seconds"),
         "compile_tax_s": compile_tax.get("total_s"),
+        "peak_program_bytes": compile_tax.get("peak_program_bytes"),
         "peak_hbm_gib": hbm.get("peak_gib"),
         "padding_waste": (report.get("padding") or {}).get("padding_waste_frac"),
         "phase_coverage": report.get("phase_coverage"),
@@ -567,6 +613,54 @@ def render_human(report: Dict[str, Any]) -> str:
             lines.append(
                 f"{name[:28]:<28} {p['builds']:>6} {p['lower_s']:>8} "
                 f"{p['compile_s']:>9} {p['cache_hits']:>5}  {flops}"
+            )
+        # per-program memory (the ledger's memory_analysis columns): the
+        # bytes side of every remat/donation choice
+        mem_rows = {
+            name: p
+            for name, p in tax["by_program"].items()
+            if p.get("peak_bytes") is not None
+        }
+        if mem_rows:
+            lines.append(
+                f"-- program memory (peak over programs: "
+                f"{_fmt_mib(tax.get('peak_program_bytes'))} MiB) --"
+            )
+            lines.append(
+                f"{'program':<28} {'args MiB':>9} {'out MiB':>8} "
+                f"{'temp MiB':>9} {'peak MiB':>9} {'donated':>8}"
+            )
+            for name in sorted(mem_rows):
+                p = mem_rows[name]
+                lines.append(
+                    f"{name[:28]:<28} {_fmt_mib(p.get('argument_bytes')):>9} "
+                    f"{_fmt_mib(p.get('output_bytes')):>8} "
+                    f"{_fmt_mib(p.get('temp_bytes')):>9} "
+                    f"{_fmt_mib(p.get('peak_bytes')):>9} "
+                    f"{_fmt_mib(p.get('donated_bytes')):>8}"
+                )
+    donation = report.get("donation")
+    if donation:
+        flags = donation.get("flags") or {}
+        lines.append(
+            f"-- donation audit -- donate_train_state="
+            f"{flags.get('donate_train_state')} donate_batch="
+            f"{flags.get('donate_batch')}; donated "
+            f"{_fmt_mib(donation.get('donated_bytes'))} MiB, left on table "
+            f"{_fmt_mib(donation.get('left_on_table_bytes'))} MiB --"
+        )
+        for row in donation.get("rows") or []:
+            lines.append(
+                f"  {row['program']:<24} donated={','.join(row['donated']) or '-'} "
+                f"not_donated={','.join(row['not_donated']) or '-'} "
+                f"left_on_table={_fmt_mib(row['left_on_table_bytes'])} MiB"
+            )
+        if donation.get("refused"):
+            refused = donation["refused"]
+            lines.append(
+                f"  DONATION REFUSED by aliasing self-check: verdict="
+                f"{refused.get('verdict')} worst_param_rel="
+                f"{refused.get('worst_param_rel')}"
             )
     padding = report.get("padding")
     if padding:

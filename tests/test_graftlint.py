@@ -833,6 +833,41 @@ def test_self_gate_covers_request_tracing_paths_explicitly():
     )
 
 
+def test_self_gate_covers_program_memory_paths_explicitly():
+    """The program-memory round (ISSUE 12) sits inside the self-gate on
+    its own terms: the bucket tuner + its CLI are exit-code consumers
+    (GL301 territory), the donation module builds probe systems (GL120/121
+    seeded-RNG territory), and the touched core/compile-ledger paths carry
+    the remat/donation seams — zero unsuppressed findings even if the
+    top-level path list is ever restructured."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join("howtotrainyourmamlpytorch_tpu", "serving", "buckets.py"),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "observability", "donation.py"
+                ),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "observability", "costs.py"
+                ),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "observability",
+                    "compile_ledger.py",
+                ),
+                os.path.join("howtotrainyourmamlpytorch_tpu", "core", "maml.py"),
+                os.path.join("scripts", "bucket_tune.py"),
+                os.path.join("scripts", "donation_probe.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in program-memory paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_catches_an_introduced_true_positive(tmp_path):
     """End-to-end: drop one fixture true positive next to real package code
     and the CLI must exit 1 with a GL id on stdout."""

@@ -1,0 +1,700 @@
+"""Program-memory round (ISSUE 12): remat policy, donation audit + aliasing
+self-check, traffic-driven bucket auto-tuning.
+
+- **remat policy**: config resolution/validation, the legacy boolean's
+  bit-identical derivation (jaxpr-pinned), and meta-gradient parity across
+  every supported policy (remat must move bytes, never results — the bar
+  jax's ``everything_saveable`` measurably fails on this jax, which is why
+  the config rejects it).
+- **ledger memory columns**: schema pin for ``program_memory`` /
+  the ledger's ``memory`` entry, with the PR 7 never-raise contract on
+  backends that hide ``memory_analysis``.
+- **donation**: audit-table arithmetic, batch-donation bit-identity on CPU,
+  self-check pass/refuse with a fake corrupting backend, and the runner
+  refusing donation on a corruption verdict.
+- **bucket tuner**: DP optimality against brute force, waste reduction on a
+  recorded access log, and the overrides round-tripping into the engine
+  bucket tables / strict-mode planned set / prewarm grid.
+"""
+
+import itertools
+import json
+import os
+import random
+import subprocess
+import sys
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import (
+    REMAT_POLICIES,
+    Config,
+    ServingConfig,
+    load_config,
+)
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.core.maml import apply_remat_policy
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+from howtotrainyourmamlpytorch_tpu.observability import donation
+from howtotrainyourmamlpytorch_tpu.observability.compile_ledger import CompileLedger
+from howtotrainyourmamlpytorch_tpu.observability.costs import program_memory
+from howtotrainyourmamlpytorch_tpu.serving import buckets as bucket_mod
+
+from .test_maml_core import TINY_SHAPE, tiny_config
+from .test_runner import toy_dataset  # noqa: F401 — fixture for the gate test
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: every supported explicit policy ("" excluded: it is the derivation alias)
+EXPLICIT_POLICIES = tuple(p for p in REMAT_POLICIES if p)
+
+
+def _tiny_system(**overrides):
+    cfg = tiny_config(**overrides)
+    model = build_vgg(
+        TINY_SHAPE, cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4
+    )
+    return cfg, MAMLSystem(cfg, model=model)
+
+
+def _batch(seed=0):
+    return {
+        k: jnp.asarray(v)
+        for k, v in synthetic_batch(2, 3, 2, 2, TINY_SHAPE, seed=seed).items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# 1. remat policy: config surface + legacy bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_remat_policy_resolution_and_validation(tmp_path):
+    # legacy derivation: the boolean maps onto the policy dial exactly
+    assert Config().resolved_remat_policy == "full"
+    assert Config(remat_inner_steps=False).resolved_remat_policy == "none"
+    # an explicit policy wins over the boolean
+    cfg = Config(remat_policy="dots_saveable", remat_inner_steps=False)
+    assert cfg.resolved_remat_policy == "dots_saveable"
+    with pytest.raises(ValueError):
+        Config(remat_policy="bogus")
+    # everything_saveable is deliberately rejected: it changes the primal
+    # under grad on this jax (see config.REMAT_POLICIES)
+    with pytest.raises(ValueError):
+        Config(remat_policy="everything_saveable")
+    # dotlist + YAML round-trip
+    cfg = load_config(None, ["remat_policy=dots_saveable", "donate_batch=true"])
+    assert cfg.remat_policy == "dots_saveable" and cfg.donate_batch
+    from howtotrainyourmamlpytorch_tpu.config import save_config
+
+    path = tmp_path / "cfg.yaml"
+    save_config(cfg, str(path))
+    again = load_config(str(path))
+    assert again.remat_policy == "dots_saveable"
+    assert again.donate_batch and not again.donate_train_state
+    assert again.donation_selfcheck  # gate on by default
+
+
+def test_apply_remat_policy_mapping():
+    step = lambda c, x: (c, None)
+    assert apply_remat_policy(step, "none") is step  # zero wrapping
+    assert apply_remat_policy(step, "full") is not step
+    assert apply_remat_policy(step, "dots_saveable") is not step
+    with pytest.raises(ValueError):
+        apply_remat_policy(step, "not_a_policy")
+
+
+def test_legacy_boolean_traces_identical_program():
+    """remat_policy="" must trace the EXACT jaxpr the legacy boolean did —
+    the off-by-default bit-identity evidence for the whole dial."""
+    _, legacy_on = _tiny_system(remat_inner_steps=True)
+    _, explicit_full = _tiny_system(remat_inner_steps=False, remat_policy="full")
+    _, legacy_off = _tiny_system(remat_inner_steps=False)
+    _, explicit_none = _tiny_system(remat_inner_steps=True, remat_policy="none")
+    batch = _batch()
+    xs = batch["x_support"][0].reshape((-1,) + TINY_SHAPE)
+    ys = batch["y_support"][0].reshape(-1)
+
+    def rollout_jaxpr(system):
+        state = system.init_train_state()
+        hparams = system._inner_hparams_for_rollout(
+            state.inner_hparams, state.params
+        )
+        inner0 = system._initial_inner_state(state.params, hparams, state.opt_state)
+        return str(
+            jax.make_jaxpr(
+                lambda p, h, i: system._adapt_loop(
+                    p, state.bn_state, h, i, xs, ys, True,
+                    system.cfg.number_of_training_steps_per_iter,
+                )
+            )(state.params, hparams, inner0)
+        )
+
+    assert rollout_jaxpr(legacy_on) == rollout_jaxpr(explicit_full)
+    assert rollout_jaxpr(legacy_off) == rollout_jaxpr(explicit_none)
+    assert rollout_jaxpr(legacy_on) != rollout_jaxpr(legacy_off)
+
+
+# ---------------------------------------------------------------------------
+# 2. meta-gradient parity across every remat policy (the PR 9 harness)
+# ---------------------------------------------------------------------------
+
+
+def _meta_grads(system, state, batch):
+    tr = {"params": state.params, "hparams": state.inner_hparams}
+
+    def obj(t):
+        loss, _ = system._meta_objective(
+            t, state.bn_state, state.opt_state, batch, 0, True,
+            system.cfg.number_of_training_steps_per_iter, True,
+        )
+        return loss
+
+    return jax.jit(jax.value_and_grad(obj))(tr)
+
+
+def test_meta_grad_parity_across_remat_policies():
+    """Remat is exact: every policy's meta-gradient must agree with the
+    unremateralized program at global cosine >= 0.995 (the PR 9 tolerance;
+    measured agreement is bitwise-to-1e-8 on CPU) and the primal loss must
+    match. The everything_saveable failure mode — a DIFFERENT loss under
+    grad — is exactly what this gate exists to catch."""
+    batch = _batch()
+    ref = None
+    ref_loss = None
+    for policy in ("none",) + tuple(p for p in EXPLICIT_POLICIES if p != "none"):
+        _, system = _tiny_system(
+            remat_inner_steps=False, remat_policy=policy, unroll_inner_steps=False
+        )
+        state = system.init_train_state()
+        loss, grads = _meta_grads(system, state, batch)
+        flat = np.concatenate(
+            [np.asarray(l, np.float64).ravel() for l in jax.tree.leaves(grads)]
+        )
+        if ref is None:
+            ref, ref_loss = flat, float(loss)
+            continue
+        assert abs(float(loss) - ref_loss) < 1e-5, (
+            f"{policy}: primal loss moved under remat "
+            f"({float(loss)} vs {ref_loss})"
+        )
+        cos = float(
+            flat @ ref / (np.linalg.norm(flat) * np.linalg.norm(ref) or 1.0)
+        )
+        assert cos >= 0.995, f"{policy}: global meta-grad cosine {cos:.6f}"
+
+
+def test_msl_rollout_logits_carry_dtype_pinned():
+    """The MSL scan's logits carry is built in the policy's logits dtype
+    (f32 — what cast_logits exits in), so under bf16_inner the carry dtype
+    is pinned by policy, not promotion accident."""
+    from howtotrainyourmamlpytorch_tpu.config import PrecisionConfig
+
+    cfg, system = _tiny_system(precision=PrecisionConfig(enabled=True))
+    assert system.precision.logits_dtype == jnp.float32
+    state = system.init_train_state()
+    batch = _batch()
+    # eval_shape traces the msl (per-step-target) variant without compiling
+    tr = {"params": state.params, "hparams": state.inner_hparams}
+    out = jax.eval_shape(
+        lambda t, b: system._meta_objective(
+            t, state.bn_state, state.opt_state, b, 0, True,
+            cfg.number_of_training_steps_per_iter, True,
+        ),
+        tr,
+        batch,
+    )
+    _, aux = out
+    assert aux["target_logits"].dtype == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# 3. ledger memory columns: schema pin + null-with-reason
+# ---------------------------------------------------------------------------
+
+MEMORY_KEYS = {
+    "argument_bytes",
+    "output_bytes",
+    "temp_bytes",
+    "generated_code_bytes",
+    "alias_bytes",
+    "peak_bytes",
+    "error",
+}
+
+
+def test_program_memory_schema_and_null_reason():
+    compiled = jax.jit(lambda x: x * 2).lower(jnp.ones((4, 4))).compile()
+    mem = program_memory(compiled)
+    assert set(mem) == MEMORY_KEYS
+    assert mem["error"] is None
+    assert mem["argument_bytes"] == 64 and mem["output_bytes"] == 64
+    assert isinstance(mem["peak_bytes"], int)
+
+    # the PR 7 crash-class contract: no attribute, a raising attribute, and
+    # a None return all degrade to null-with-reason, never an exception
+    class NoAnalysis:
+        pass
+
+    class Raising:
+        @property
+        def memory_analysis(self):
+            raise RuntimeError("plugin says no")
+
+    class ReturnsNone:
+        def memory_analysis(self):
+            return None
+
+    for broken in (NoAnalysis(), Raising(), ReturnsNone()):
+        mem = program_memory(broken)
+        assert set(mem) == MEMORY_KEYS
+        assert mem["peak_bytes"] is None
+        assert mem["error"]
+
+
+def test_ledger_entries_carry_memory_and_summary_peaks():
+    ledger = CompileLedger()
+    entries = []
+    ledger.on_entry = entries.append
+    fn = ledger.wrap_build(("probe", 4), jax.jit(lambda x: (x @ x).sum()))
+    fn(jnp.ones((8, 8)))
+    (entry,) = entries
+    assert set(entry["memory"]) == MEMORY_KEYS
+    assert entry["memory"]["argument_bytes"] == 256
+    summary = ledger.summary()
+    assert summary["peak_program_bytes"] == entry["memory"]["peak_bytes"]
+    row = summary["by_program"]["probe/4"]
+    assert row["peak_bytes"] == entry["memory"]["peak_bytes"]
+    # donation summary: no aliasing on this program -> None (0 filtered)
+    assert summary["donated_bytes"] is None
+
+
+# ---------------------------------------------------------------------------
+# 4. donation: audit arithmetic, batch bit-identity, self-check gate
+# ---------------------------------------------------------------------------
+
+
+def test_donation_audit_arithmetic():
+    assert donation.tree_bytes({"a": np.zeros((2, 3), np.float32)}) == 24
+    assert donation.tree_bytes(
+        {"s": jax.ShapeDtypeStruct((4,), np.dtype(np.int32)), "none": None}
+    ) == 16
+
+    cfg = tiny_config(donate_batch=True, train_steps_per_dispatch=2)
+    spec = donation.episode_batch_spec(cfg)
+    real = synthetic_batch(
+        cfg.batch_size, cfg.num_classes_per_set, cfg.num_samples_per_class,
+        cfg.num_target_samples, cfg.image_shape, seed=0,
+    )
+    assert {k: (v.shape, str(v.dtype)) for k, v in spec.items()} == {
+        k: (v.shape, str(v.dtype)) for k, v in real.items()
+    }
+
+    state = {"w": np.zeros((10,), np.float32)}  # any same-shape tree works
+    audit = donation.donation_audit(cfg, state)
+    assert audit["flags"] == {"donate_train_state": False, "donate_batch": True}
+    assert audit["state_bytes"] == 40
+    batch_bytes = donation.tree_bytes(spec)
+    assert audit["batch_bytes"] == batch_bytes
+    by_program = {r["program"]: r for r in audit["rows"]}
+    single = by_program["train/True/True"]
+    multi = by_program["train_multi/True/True"]
+    assert single["donated"] == ["batch"] and single["not_donated"] == ["state"]
+    assert single["donated_bytes"] == batch_bytes
+    assert single["left_on_table_bytes"] == 40
+    # the K-chunk counts its stacked [K] batch axis
+    assert multi["donated_bytes"] == 2 * batch_bytes
+    assert audit["donated_bytes"] == 2 * batch_bytes
+
+
+def test_batch_donation_bit_identity_on_cpu():
+    """donate_batch on vs off: identical per-step losses and final params
+    over streamed fresh batches — donation must be a pure memory
+    optimization (and the off path is the shipped default)."""
+
+    def run(donate):
+        cfg, system = _tiny_system(donate_batch=donate, remat_inner_steps=False)
+        state = system.init_train_state()
+        losses = []
+        with warnings.catch_warnings():
+            # CPU warns that donated buffers are unused; that is the point
+            warnings.simplefilter("ignore")
+            for i in range(3):
+                batch = {
+                    k: jax.device_put(np.asarray(v))
+                    for k, v in synthetic_batch(2, 3, 2, 2, TINY_SHAPE, seed=i).items()
+                }
+                state, out = system.train_step(state, batch, epoch=0)
+                losses.append(float(out.loss))
+        return losses, jax.device_get(state.params)
+
+    losses_on, params_on = run(True)
+    losses_off, params_off = run(False)
+    assert losses_on == losses_off
+    assert all(
+        np.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(params_on), jax.tree.leaves(params_off))
+    )
+
+
+def test_donation_selfcheck_clean_and_corrupting_backend():
+    # fake clean backend: arms agree bitwise
+    params = {"w": np.ones(3)}
+
+    def clean_arm(donate):
+        return [1.0, 0.9], params
+
+    res = donation.donation_selfcheck(tiny_config(), run_arm=clean_arm)
+    assert res["verdict"] == "clean"
+
+    # fake corrupting backend: the donate arm diverges immediately and
+    # catastrophically (the round-4 signature: losses off from the early
+    # window, params off by ~1e-1 rel) — verdict flips, evidence carried
+    def corrupt_arm(donate):
+        if donate:
+            return [1.0, 2.5], {"w": np.ones(3) * 1.7}
+        return [1.0, 0.9], params
+
+    res = donation.donation_selfcheck(tiny_config(), run_arm=corrupt_arm)
+    assert res["verdict"] == "corruption"
+    assert res["early_loss_dev"] > donation.EARLY_LOSS_TOL
+    assert res["global_param_rel"] > donation.CATASTROPHIC_REL
+    assert res["first_step_deviating"] == 1
+
+    # honest reorder amplification (measured on the virtual-device CPU:
+    # early steps agree to float noise, late steps drift) must NOT trip
+    def reorder_arm(donate):
+        if donate:
+            return [1.0, 0.9 + 1e-6, 0.85, 0.83], {"w": np.ones(3) * 1.002}
+        return [1.0, 0.9, 0.84, 0.80], params
+
+    res = donation.donation_selfcheck(tiny_config(), run_arm=reorder_arm)
+    assert res["verdict"] == "clean"
+
+
+def test_donation_selfcheck_real_arms_clean_on_cpu():
+    """The real tiny A/B on this backend: the donate and no-donate
+    programs differ only by float reordering (and on the 8-virtual-device
+    test platform they measurably DO reorder — see the threshold note in
+    observability/donation.py), so the gate must certify clean."""
+    res = donation.donation_selfcheck(tiny_config(), n_steps=2, n_batches=2)
+    assert res["verdict"] == "clean"
+    assert res["backend"] == "cpu"
+    # the discriminator: the early loss window sits at float noise
+    assert res["early_loss_dev"] <= 1e-5
+
+
+def test_runner_refuses_donation_on_corruption_verdict(
+    toy_dataset, tmp_path, monkeypatch
+):
+    """Runner wiring: a corruption verdict flips donate_train_state off
+    BEFORE any train program builds, lands a donation_refused event, and
+    the run completes no-donate."""
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentRunner
+
+    from .test_runner import runner_config, small_system
+
+    monkeypatch.setattr(
+        donation,
+        "donation_selfcheck",
+        lambda cfg, **kw: {
+            "verdict": "corruption",
+            "backend": "fake",
+            "worst_param_rel": 0.32,
+            "max_loss_dev": 1.0,
+        },
+    )
+    cfg = runner_config(
+        toy_dataset,
+        tmp_path,
+        experiment_name="toy_donation_gate",
+        donate_train_state=True,
+        total_epochs=1,
+        total_iter_per_epoch=2,
+        num_evaluation_tasks=2,
+    )
+    runner = ExperimentRunner(cfg, system=small_system(cfg))
+    runner.run_experiment()
+    assert cfg.donate_train_state is False
+    events = [
+        json.loads(line)
+        for line in open(os.path.join(runner.logs_dir, "events.jsonl"))
+    ]
+    names = [e.get("event") for e in events]
+    assert "donation_refused" in names
+    refused = next(e for e in events if e.get("event") == "donation_refused")
+    assert refused["verdict"] == "corruption"
+    # the audit event rides every run (flags reflect the refusal)
+    audit = next(e for e in events if e.get("event") == "donation_audit")
+    assert audit["flags"]["donate_train_state"] is False
+
+
+# ---------------------------------------------------------------------------
+# 5. bucket auto-tuner
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_tuner_dp_is_optimal():
+    """The DP must match brute force over every edge subset (edges end at
+    the max observed size) — the optimality pin for the solver."""
+    rng = random.Random(7)
+    for _ in range(40):
+        sizes = rng.sample(range(1, 40), rng.randint(1, 7))
+        hist = {s: rng.randint(1, 20) for s in sizes}
+        k = rng.randint(1, 5)
+        edges = bucket_mod.optimal_edges(hist, k)
+        cost = bucket_mod.padded_samples(hist, edges)
+        ss = sorted(hist)
+        best = min(
+            bucket_mod.padded_samples(hist, list(combo))
+            for kk in range(1, min(k, len(ss)) + 1)
+            for combo in itertools.combinations(ss, kk)
+            if combo[-1] == ss[-1]
+        )
+        assert cost == best and len(edges) <= k
+        # a known exact case: enough budget => zero waste
+        assert bucket_mod.waste_frac(hist, sorted(hist)) == 0.0
+
+
+def test_bucket_for_matches_engine_rule():
+    from howtotrainyourmamlpytorch_tpu.serving.engine import _bucket_for
+
+    edges = [25, 50, 100]
+    for size in (1, 25, 26, 50, 99, 100, 101, 400):
+        assert bucket_mod.bucket_for(size, edges) == _bucket_for(size, edges)
+
+
+def test_batch_bucket_count_matches_strictmode():
+    from howtotrainyourmamlpytorch_tpu.utils.strictmode import batch_buckets
+
+    for max_batch in (1, 2, 3, 4, 6, 8, 12, 16):
+        assert bucket_mod.batch_bucket_count(max_batch) == len(
+            batch_buckets(max_batch)
+        )
+
+
+def test_tuner_reduces_waste_and_overrides_flow_everywhere(tmp_path):
+    """End to end over a recorded access log: the tuned edges strictly
+    reduce padding_waste_frac, and the emitted overrides land in the engine
+    bucket tables, the strict-mode planned set, and therefore the prewarm
+    grid (which walks the same planned set)."""
+    log = tmp_path / "access.jsonl"
+    with open(log, "w") as f:
+        for size, n in ((10, 40), (12, 20), (55, 3)):
+            for _ in range(n):
+                f.write(
+                    json.dumps({"verb": "adapt", "true_size": size, "outcome": "ok"})
+                    + "\n"
+                )
+        for _ in range(30):
+            f.write(
+                json.dumps({"verb": "predict", "true_size": 7, "outcome": "ok"})
+                + "\n"
+            )
+        # sheds and torn lines must not count
+        f.write(json.dumps({"verb": "adapt", "true_size": 999, "outcome": "shed"}) + "\n")
+        f.write("torn{\n")
+
+    traffic = bucket_mod.traffic_from_access_log(str(log))
+    assert 999 not in traffic["adapt"]
+    result = bucket_mod.tune(
+        traffic,
+        current_support=[25, 50, 100, 200],
+        current_query=[5, 15, 40, 100],
+        max_buckets=3,
+    )
+    assert (
+        result["padding_waste_frac_after"] < result["padding_waste_frac_before"]
+    )
+    cfg = load_config(None, result["overrides"])
+    assert cfg.serving.support_buckets == result["edges"]["support_buckets"]
+    assert cfg.serving.query_buckets == result["edges"]["query_buckets"]
+
+    from howtotrainyourmamlpytorch_tpu.utils.strictmode import (
+        batch_buckets,
+        serving_planned_programs,
+    )
+
+    planned = serving_planned_programs(cfg.serving)
+    batches = batch_buckets(cfg.serving.max_batch_size)
+    for bucket in result["edges"]["support_buckets"]:
+        for b in batches:
+            assert ("adapt", bucket, b) in planned
+    assert len(planned) == len(batches) * (
+        len(cfg.serving.support_buckets) + len(cfg.serving.query_buckets)
+    )
+
+
+def test_bucket_tune_cli_and_default_pins(tmp_path):
+    """CLI contract (one JSON line, rc 0/2) + the import-light script's
+    literal defaults pinned against the real ServingConfig dataclass."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bucket_tune", os.path.join(REPO_ROOT, "scripts", "bucket_tune.py")
+    )
+    tune_cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tune_cli)
+    defaults = ServingConfig()
+    assert tune_cli.DEFAULT_SUPPORT_BUCKETS == defaults.support_buckets
+    assert tune_cli.DEFAULT_QUERY_BUCKETS == defaults.query_buckets
+    assert tune_cli.DEFAULT_MAX_BATCH == defaults.max_batch_size
+
+    log = tmp_path / "access.jsonl"
+    with open(log, "w") as f:
+        for _ in range(20):
+            f.write(
+                json.dumps({"verb": "adapt", "true_size": 10, "outcome": "ok"}) + "\n"
+            )
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "bucket_tune.py"),
+            "--access-log",
+            str(log),
+            "--max-programs",
+            "16",
+            "--write-overrides",
+            str(tmp_path / "overrides.txt"),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    report = json.loads(out.stdout)
+    assert report["ok"] and report["edges"]["support_buckets"] == [10]
+    # --max-programs 16 with max_batch 8 (4 batch buckets) => 2 shape
+    # buckets per verb
+    assert tune_cli.buckets.shape_buckets_for_program_budget(16, 8) == 2
+    assert (tmp_path / "overrides.txt").read_text().splitlines() == report[
+        "overrides"
+    ]
+    # usage rc on no traffic
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "bucket_tune.py")],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert out.returncode == 2
+
+
+def test_padding_by_bucket_metrics_feed_the_tuner():
+    """The /metrics per-bucket tallies (server._note_padding) round-trip
+    through traffic_from_metrics into a tunable histogram."""
+    from types import SimpleNamespace
+
+    from howtotrainyourmamlpytorch_tpu.observability import MetricsRegistry
+    from howtotrainyourmamlpytorch_tpu.serving.server import ServingFrontend
+
+    stub = SimpleNamespace(hub=SimpleNamespace(registry=MetricsRegistry()))
+    for true, bucket in ((10, 25), (12, 25), (60, 100)):
+        ServingFrontend._note_padding(stub, "adapt", true, bucket)
+    ServingFrontend._note_padding(stub, "predict", 7, 15)
+    stats = ServingFrontend.padding_stats(stub)
+    assert stats["by_bucket"]["adapt"]["25"] == {"count": 2, "true_samples": 22}
+    assert stats["by_bucket"]["predict"]["15"] == {"count": 1, "true_samples": 7}
+    traffic = bucket_mod.traffic_from_metrics({"padding": stats})
+    # bucket means, plus the coverage sentinel at the largest occupied
+    # bucket edge (sizes within a bucket are only known up to the edge)
+    assert traffic["adapt"] == {11: 2, 60: 1, 100: 1}
+    assert traffic["predict"] == {7: 1, 15: 1}
+
+
+# ---------------------------------------------------------------------------
+# 6. bench knob mapping
+# ---------------------------------------------------------------------------
+
+
+def test_keep_max_edge_survives_a_full_budget():
+    """--keep-max-edge must spend its documented budget slot even when the
+    DP would otherwise use the whole budget (the common case): the current
+    top edge survives, within budget."""
+    hist = {5: 10, 9: 10, 14: 10, 30: 10}  # 4 distinct sizes
+    res = bucket_mod.tune(
+        {"adapt": hist, "predict": {}},
+        current_support=[25, 50, 100, 200],
+        current_query=[5, 15],
+        max_buckets=3,
+        keep_max_edge=True,
+    )
+    edges = res["edges"]["support_buckets"]
+    assert edges[-1] == 200 and len(edges) <= 3
+    # budget 1: coverage wins — the single edge is the current top
+    res1 = bucket_mod.tune(
+        {"adapt": hist, "predict": {}},
+        current_support=[25, 50, 100, 200],
+        current_query=[5, 15],
+        max_buckets=1,
+        keep_max_edge=True,
+    )
+    assert res1["edges"]["support_buckets"] == [200]
+
+
+def test_metrics_traffic_pins_top_edge_coverage():
+    """The metrics path only knows sizes up to each bucket's edge; the
+    sentinel at the largest occupied bucket keeps recorded traffic
+    coverable — tuned edges can move DOWN for interior mass but the top
+    edge never drops below the recorded upper bound."""
+    stats = {
+        "by_bucket": {
+            "predict": {"100": {"count": 50, "true_samples": 3750}}  # mean 75
+        }
+    }
+    traffic = bucket_mod.traffic_from_metrics({"padding": stats})
+    assert traffic["predict"] == {75: 50, 100: 1}
+    edges = bucket_mod.optimal_edges(traffic["predict"], 2)
+    assert edges[-1] == 100  # recorded sizes 76..100 stay covered
+
+
+def test_program_memory_partial_analysis_withholds_peak():
+    """A backend exposing only some of argument/output/temp must NOT get a
+    partial-sum peak (temps dominate the remat'd meta-step — a partial sum
+    silently understates the OOM headline): peak null, reason named."""
+
+    class Partial:
+        def memory_analysis(self):
+            class MA:
+                argument_size_in_bytes = 100
+                output_size_in_bytes = 50
+                # no temp_size_in_bytes
+
+            return MA()
+
+    mem = program_memory(Partial())
+    assert mem["argument_bytes"] == 100 and mem["output_bytes"] == 50
+    assert mem["peak_bytes"] is None
+    assert "temp" in mem["error"]
+
+
+def test_bench_serving_rejects_bad_remat_knob():
+    """BENCH_REMAT typos exit the rc-2 usage contract (one stderr line),
+    matching the adjacent BENCH_PRECISION knob — never a mid-main
+    traceback an armed sweep can't classify."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_REMAT="dots")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench_serving.py"), "--tiny"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert out.returncode == 2, out.stderr
+    assert "BENCH_REMAT" in out.stderr
+
+
+def test_bench_remat_knob_mapping():
+    import bench
+
+    assert bench._remat_overrides("") == {"remat_inner_steps": False}
+    assert Config(**bench._remat_overrides("")).resolved_remat_policy == "none"
+    over = bench._remat_overrides("dots_saveable")
+    assert Config(**over).resolved_remat_policy == "dots_saveable"
+    with pytest.raises(ValueError):
+        Config(**bench._remat_overrides("everything_saveable"))
