@@ -34,6 +34,62 @@ os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 import numpy as np
 
 
+def _gateway_bench(args, url: str) -> int:
+    """External-process target (``--url`` / ``BENCH_GATEWAY``): drive an
+    ALREADY-RUNNING gateway with the open-loop SLO staircase and emit ONE
+    JSON line with per-backend outcome counts — the recipe a chip session
+    uses to measure a live multi-host fleet without rebuilding it."""
+    from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+    from howtotrainyourmamlpytorch_tpu.observability import slo
+
+    img = (28, 28, 1)
+    stairs = [
+        float(s)
+        for s in os.environ.get("BENCH_SLO_STAIRS", "4,8").split(",")
+        if s.strip()
+    ]
+    duration = float(os.environ.get("BENCH_SLO_DURATION_S", "10"))
+    schedule = slo.generate_schedule(
+        0, duration, stairs,
+        adapt_frac=0.25, query_sizes=(args.n_query,), query_weights=(1.0,),
+    )
+    if not schedule:
+        print("bench_serving: empty schedule for the gateway staircase",
+              file=sys.stderr)
+        return 2
+
+    def episode(seed):
+        b = synthetic_batch(
+            1, args.n_way, args.k_shot,
+            max(args.n_query // args.n_way, 1), img, seed & 0x7FFFFFFF,
+        )
+        return (
+            b["x_support"][0],
+            b["y_support"][0],
+            b["x_target"][0].reshape((-1,) + img)[: args.n_query],
+        )
+
+    frontend = slo.HttpFrontend(url)
+    run = slo.run_load(
+        frontend,
+        schedule,
+        lambda seed: episode(seed)[:2],
+        lambda seed, n_q: episode(seed)[2][:n_q],
+        log=lambda m: print(m, file=sys.stderr, flush=True),
+    )
+    report = slo.slo_report(
+        schedule, run, stairs_rps=stairs, duration_s=duration, seed=0,
+        slo_p99_ms=float(os.environ.get("BENCH_SLO_P99_MS", "2000")),
+        max_shed_rate=0.05,
+        metric_suffix=f"_gateway_{args.n_way}w{args.k_shot}s",
+        platform="external",
+        target=url,
+        per_backend=frontend.per_backend(),
+    )
+    print(json.dumps(report), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--n-way", type=int, default=5)
@@ -44,7 +100,17 @@ def main(argv=None) -> int:
     parser.add_argument("--batch", type=int, default=8, help="micro-batch size for throughput")
     parser.add_argument("--tiny", action="store_true",
                         help="2-stage 4-filter backbone (CI smoke)")
+    parser.add_argument(
+        "--url", default=None,
+        help="drive an already-running gateway/frontend at this base URL "
+        "(BENCH_GATEWAY env is the same knob): SLO staircase only, with "
+        "per-backend outcome counts in the JSON line",
+    )
     args = parser.parse_args(argv)
+
+    gateway_url = args.url or os.environ.get("BENCH_GATEWAY", "")
+    if gateway_url:
+        return _gateway_bench(args, gateway_url)
 
     import jax
 

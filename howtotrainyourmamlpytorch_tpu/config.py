@@ -157,6 +157,19 @@ class ServingConfig:
     port: int = 8100
     # per-phase latency ring-buffer length for the /metrics percentiles
     latency_window: int = 2048
+    # Graceful drain (SIGTERM -> serving/server.py::begin_drain): how long
+    # the process waits for in-flight + queued requests to complete before
+    # giving up. A clean drain exits 0; deadline expiry exits
+    # exit_codes.DRAIN_DEADLINE (77) so the supervisor knows the replica's
+    # last seconds were lossy.
+    drain_deadline_s: float = 30.0
+    # Spill hot adapted sessions content-addressed to
+    # <run>/saved_models/sessions/ at drain, and rehydrate them
+    # (digest-verified, fingerprint-matched, TTL-honored) at startup — a
+    # rolling restart costs cache warmth bookkeeping, never correctness.
+    # Only active for run-dir engines (an engine with no run dir has
+    # nowhere durable to spill).
+    session_spill: bool = True
 
     def __post_init__(self):
         self.support_buckets = sorted(int(b) for b in self.support_buckets)
@@ -179,6 +192,10 @@ class ServingConfig:
             )
         if self.latency_window < 1:
             raise ValueError(f"latency_window must be >= 1, got {self.latency_window}")
+        if self.drain_deadline_s <= 0:
+            raise ValueError(
+                f"drain_deadline_s must be > 0, got {self.drain_deadline_s}"
+            )
 
 
 @dataclass

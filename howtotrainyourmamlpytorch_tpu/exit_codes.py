@@ -43,6 +43,14 @@ TPU_WAIT_DEADLINE = 64
 #: K consecutive probes hung — the dead-tunnel signature; gave up early
 TPU_WAIT_WEDGED = 65
 
+# --- serving drain codes (serving/server.py, scripts/serve.py) ------------
+#: graceful drain (SIGTERM to a serving process) could not complete inside
+#: serving.drain_deadline_s: in-flight/queued work was still pending when
+#: the deadline expired. Hot sessions were still spilled and logs closed,
+#: but a request may have been dropped — the supervisor should treat the
+#: replica's last seconds as lossy. A clean drain exits 0.
+DRAIN_DEADLINE = 77
+
 # --- serving HTTP degradation codes (serving/server.py) -------------------
 #: router admission control: the session's affine replica is at its
 #: admission bound — shed BEFORE queueing, sent with Retry-After
@@ -79,6 +87,8 @@ def describe(rc: int) -> str:
         return "TPU wait gate: deadline exceeded"
     if rc == TPU_WAIT_WEDGED:
         return "TPU wait gate: consecutive probes hung (dead tunnel)"
+    if rc == DRAIN_DEADLINE:
+        return "serving drain: deadline exceeded with work still in flight"
     if rc == USAGE:
         return "usage / structured failure"
     return f"undocumented exit code {rc}"

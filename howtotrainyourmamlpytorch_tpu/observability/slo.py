@@ -27,13 +27,16 @@ else). CLI: ``scripts/loadgen.py``.
 import concurrent.futures
 import dataclasses
 import inspect
+import json
 import threading
 import time
+import urllib.error
+import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .context import new_request_context, read_access_log
+from .context import format_traceparent, new_request_context, read_access_log
 
 #: how many worst request ids a failing stair names in the SLO report —
 #: enough to grep their flow traces, small enough to stay one JSON line
@@ -120,6 +123,136 @@ def schedule_digest(schedule: List[Request]) -> Dict[str, Any]:
         "first_t": schedule[0].t if schedule else None,
         "last_t": schedule[-1].t if schedule else None,
     }
+
+
+class _NullBreaker:
+    """Breaker stand-in for external-process targets: the remote breaker's
+    trips ride the remote /metrics, not this snapshot."""
+
+    @staticmethod
+    def snapshot() -> Dict[str, Any]:
+        return {}
+
+
+class _NullHub:
+    enabled = False
+
+
+class HttpFrontend:
+    """The ServingFrontend request API over a live gateway (or single
+    backend) URL — what ``loadgen.py --url`` / ``BENCH_GATEWAY`` drive, so
+    the SAME open-loop harness measures an external-process fleet.
+
+    Failure mapping mirrors the wire contract in reverse (429/503 ->
+    ``ServiceUnavailableError``, 504 -> ``DeadlineExceededError``, 404 ->
+    ``UnknownAdaptationError``), so :func:`run_load`'s outcome taxonomy is
+    identical in-process and over HTTP. Every response's
+    ``X-Gateway-Backend`` header is tallied per outcome — the per-backend
+    story of the SLO report (``per_backend``)."""
+
+    def __init__(self, base_url: str, timeout_s: float = 120.0):
+        from ..exit_codes import (
+            HTTP_DEADLINE,
+            HTTP_TOO_MANY_REQUESTS,
+            HTTP_UNAVAILABLE,
+        )
+        from ..resilience.retry import DeadlineExceededError
+        from ..serving.errors import ServiceUnavailableError, UnknownAdaptationError
+
+        self._shed_codes = (HTTP_TOO_MANY_REQUESTS, HTTP_UNAVAILABLE)
+        self._deadline_code = HTTP_DEADLINE
+
+        self.base = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self._unavailable = ServiceUnavailableError
+        self._deadline = DeadlineExceededError
+        self._unknown = UnknownAdaptationError
+        self._lock = threading.Lock()
+        self._by_backend: Dict[str, Dict[str, int]] = {}
+        self.breaker = _NullBreaker()
+        self.hub = _NullHub()
+        self.access_log = None
+        self.engine = None  # run_load's prewarm degrades to a logged skip
+
+    def _note(self, backend: Optional[str], outcome: str) -> None:
+        with self._lock:
+            row = self._by_backend.setdefault(backend or "unknown", {})
+            row[outcome] = row.get(outcome, 0) + 1
+
+    def _post(self, path: str, payload: Dict[str, Any], ctx) -> Dict[str, Any]:
+        headers = {"Content-Type": "application/json"}
+        if ctx is not None:
+            # the loadgen-minted trace id rides the wire: gateway + backend
+            # adopt it, so one request id greps across every process's logs
+            headers["traceparent"] = format_traceparent(ctx)
+        req = urllib.request.Request(
+            self.base + path, data=json.dumps(payload).encode(), headers=headers
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                self._note(resp.headers.get("X-Gateway-Backend"), "ok")
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            backend = exc.headers.get("X-Gateway-Backend")
+            body = exc.read()
+            try:
+                message = json.loads(body).get("error") or f"HTTP {exc.code}"
+            except ValueError:
+                message = f"HTTP {exc.code}"
+            retry_after = 1.0
+            if exc.headers.get("Retry-After"):
+                try:
+                    retry_after = float(exc.headers["Retry-After"])
+                except ValueError:
+                    pass
+            if exc.code in self._shed_codes:
+                self._note(backend, "shed")
+                raise self._unavailable(
+                    message, retry_after_s=retry_after, status=exc.code
+                ) from exc
+            if exc.code == self._deadline_code:
+                self._note(backend, "deadline")
+                raise self._deadline(message) from exc
+            if exc.code == 404:
+                self._note(backend, "unknown_id")
+                raise self._unknown(message) from exc
+            self._note(backend, "error")
+            raise RuntimeError(f"{path}: {message}") from exc
+        except urllib.error.URLError as exc:
+            # connection-level failure (target down mid-test): an honest
+            # "error" row, never a crash of the harness
+            self._note(None, "error")
+            raise RuntimeError(f"{path}: {exc.reason}") from exc
+
+    def adapt(self, x_support, y_support, ctx=None) -> Dict[str, Any]:
+        return self._post(
+            "/adapt",
+            {
+                "x_support": np.asarray(x_support, np.float32).tolist(),
+                "y_support": np.asarray(y_support, np.int32).tolist(),
+            },
+            ctx,
+        )
+
+    def predict(self, adaptation_id: str, x_query, ctx=None) -> np.ndarray:
+        out = self._post(
+            "/predict",
+            {
+                "adaptation_id": adaptation_id,
+                "x_query": np.asarray(x_query, np.float32).tolist(),
+            },
+            ctx,
+        )
+        return np.asarray(out["probs"], np.float32)
+
+    def per_backend(self) -> Dict[str, Dict[str, int]]:
+        """Outcome counts per X-Gateway-Backend — the SLO report's
+        ``per_backend`` block for external-process targets."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._by_backend.items()}
+
+    def close(self) -> None:
+        pass
 
 
 class _Results:

@@ -249,6 +249,14 @@ def episode_menu(rng: np.random.RandomState) -> List[Episode]:
         # result, the fleet must keep serving, and the death must resolve
         # through the access log (serving/pool.py, serving/router.py)
         Episode(kind="serve-replica-death", mode="serve"),
+        # --- cross-process fleet drills (ISSUE 14): a REAL gateway process
+        # (scripts/gateway.py) in front of REAL serve backends (subprocess
+        # interpreters running the actual run_server drain path). Marked
+        # subprocess so the in-process smoke skips them; tier-1 runs each
+        # directly via tests/test_gateway_fleet.py.
+        Episode(kind="gateway-kill9-backend", mode="gateway", subprocess=True),
+        Episode(kind="gateway-drain-rehydrate", mode="gateway", subprocess=True),
+        Episode(kind="gateway-rolling-restart", mode="gateway", subprocess=True),
     ]
     order = rng.permutation(len(menu))
     return [menu[i] for i in order]
@@ -687,6 +695,710 @@ def _run_serve_episode(ep: Episode) -> List[str]:
 
 
 # ---------------------------------------------------------------------------
+# cross-process fleet drills (ISSUE 14): real gateway + real serve backends
+# ---------------------------------------------------------------------------
+
+
+def tiny_serving_system(cfg):
+    """The shrunken 2-stage/4-filter backbone the serving drills load —
+    deliberately NOT reconstructible from config alone, which is why
+    :func:`child_serve_main` (not scripts/serve.py) is the drill backend
+    entry: it rebuilds the same model the checkpoint was saved from."""
+    from ..core import MAMLSystem
+    from ..models import build_vgg
+
+    return MAMLSystem(
+        cfg,
+        model=build_vgg(
+            (28, 28, 1), cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4
+        ),
+    )
+
+
+def make_serving_run_dir(root: str, name: str, template: Optional[str] = None) -> str:
+    """A toy SERVING run dir a backend subprocess can load: config.yaml +
+    an init-state checkpoint + logs/. ``template`` copies another run dir's
+    config + checkpoint byte-for-byte (same fingerprint => the fleet's
+    backends agree about every session's cache key — exactly the deployed
+    shape, where every host serves the same pushed checkpoint)."""
+    import shutil
+
+    run_dir = os.path.join(root, name)
+    save_dir = os.path.join(run_dir, "saved_models")
+    os.makedirs(save_dir, exist_ok=True)
+    os.makedirs(os.path.join(run_dir, "logs"), exist_ok=True)
+    if template is not None:
+        shutil.copy(
+            os.path.join(template, "config.yaml"),
+            os.path.join(run_dir, "config.yaml"),
+        )
+        shutil.copy(
+            os.path.join(template, "saved_models", "train_model_latest"),
+            os.path.join(save_dir, "train_model_latest"),
+        )
+        return run_dir
+    from ..config import AotConfig, Config, ServingConfig, save_config
+    from ..experiment import checkpoint as ckpt
+
+    cfg = Config(
+        num_classes_per_set=5,
+        num_samples_per_class=2,
+        num_target_samples=3,
+        batch_size=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        serving=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=2,
+            cache_ttl_s=600.0,
+        ),
+        # AOT on: the respawned replica of a rolling restart loads its
+        # executables from the run's store instead of recompiling — the
+        # warm-spawn contract the drill gates on via /healthz "warming"
+        aot=AotConfig(enabled=True, max_workers=1, serving_background=True),
+        experiment_root=root,
+        experiment_name=name,
+    )
+    save_config(cfg, os.path.join(run_dir, "config.yaml"))
+    system = tiny_serving_system(cfg)
+    ckpt.save_named(save_dir, system.init_train_state(), {"epoch": 0}, "latest")
+    return run_dir
+
+
+def child_serve_main(run_dir: str, port_file: str, port: int = 0) -> int:
+    """Backend subprocess entry for the fleet drills: load the toy run dir,
+    serve it through the REAL ``run_server`` path (SIGTERM => graceful
+    drain => spill => rc), and publish the bound port to ``port_file``.
+    Importable (not ``__main__``) so the parent spawns it with a one-line
+    ``-c`` after pinning the JAX env."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # site-hook override guard
+    from ..utils.compcache import setup_compilation_cache
+
+    setup_compilation_cache(
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache"),
+        test_tuning=True,
+    )
+    from ..config import load_config
+    from ..serving.engine import AdaptationEngine
+    from ..serving.server import ServingFrontend, run_server
+
+    cfg = load_config(os.path.join(run_dir, "config.yaml"))
+    engine = AdaptationEngine.from_run_dir(
+        run_dir, "latest", cfg=cfg, system=tiny_serving_system(cfg)
+    )
+    frontend = ServingFrontend(
+        engine, access_log_dir=os.path.join(run_dir, "logs")
+    )
+
+    def _announce(host, bound_port):
+        tmp = f"{port_file}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(bound_port))
+        os.replace(tmp, port_file)
+
+    return run_server(frontend, "127.0.0.1", port, on_bound=_announce)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn_serve_backend(run_dir: str, port: int = 0, env_extra=None):
+    """Fork one serving backend over ``run_dir``; returns (proc, port_file).
+    stdout/stderr land in <run_dir>/serve_stdout.log (pipe-fill-proof)."""
+    code = (
+        "import sys;"
+        "from howtotrainyourmamlpytorch_tpu.resilience.campaign import child_serve_main;"
+        "sys.exit(child_serve_main(sys.argv[1], sys.argv[2], int(sys.argv[3])))"
+    )
+    port_file = os.path.join(run_dir, "serve_port")
+    try:
+        os.remove(port_file)
+    except FileNotFoundError:
+        pass
+    env = _child_env(1)
+    env.update(env_extra or {})
+    log_handle = open(os.path.join(run_dir, "serve_stdout.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code, run_dir, port_file, str(port)],
+        cwd=_REPO_ROOT,
+        env=env,
+        stdout=log_handle,
+        stderr=subprocess.STDOUT,
+    )
+    log_handle.close()  # the child holds its own descriptor
+    return proc, port_file
+
+
+def backend_spawn_argv(run_dir: str, port: int) -> List[str]:
+    """The respawn command a rolling restart hands scripts/rolling_restart.py
+    for one drill backend (same entry :func:`spawn_serve_backend` forks)."""
+    code = (
+        "import sys;"
+        "from howtotrainyourmamlpytorch_tpu.resilience.campaign import child_serve_main;"
+        "sys.exit(child_serve_main(sys.argv[1], sys.argv[2], int(sys.argv[3])))"
+    )
+    return [
+        sys.executable, "-c", code, run_dir,
+        os.path.join(run_dir, "serve_port"), str(port),
+    ]
+
+
+def _wait_port_file(port_file: str, proc, timeout_s: float = 240.0) -> int:
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"backend died (rc {proc.returncode}) before binding"
+            )
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                text = f.read().strip()
+            if text:
+                return int(text)
+        time.sleep(0.1)
+    raise RuntimeError(f"no port file {port_file} within {timeout_s}s")
+
+
+def _http_json(url: str, payload=None, timeout_s: float = 60.0):
+    """-> (status, body dict, headers). HTTP errors return their status;
+    connection failures raise OSError."""
+    import urllib.error
+    import urllib.request
+
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, _loads_or_empty(resp.read()), dict(resp.headers.items())
+    except urllib.error.HTTPError as exc:
+        return exc.code, _loads_or_empty(exc.read()), dict(exc.headers.items())
+    except urllib.error.URLError as exc:
+        raise OSError(str(exc.reason)) from exc
+
+
+def _loads_or_empty(blob: bytes):
+    try:
+        out = json.loads(blob)
+        return out if isinstance(out, dict) else {}
+    except ValueError:
+        return {}
+
+
+def _wait_http_ok(url: str, timeout_s: float, proc=None) -> None:
+    end = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < end:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(f"process died (rc {proc.returncode}) warming up")
+        try:
+            code, _, _ = _http_json(url, timeout_s=5.0)
+            last = code
+            if code == 200:
+                return
+        except OSError as exc:
+            last = str(exc)
+        time.sleep(0.25)
+    raise RuntimeError(f"{url} never answered 200 ({last!r}) in {timeout_s}s")
+
+
+def spawn_gateway(backend_urls: List[str], log_dir: str, **knobs):
+    """Fork scripts/gateway.py over ``backend_urls``; returns (proc, base_url)."""
+    os.makedirs(log_dir, exist_ok=True)
+    port_file = os.path.join(log_dir, "gateway_port")
+    try:
+        os.remove(port_file)
+    except FileNotFoundError:
+        pass
+    argv = [
+        sys.executable, os.path.join(_REPO_ROOT, "scripts", "gateway.py"),
+        "--backends", ",".join(backend_urls),
+        "--port", "0", "--port-file", port_file, "--log-dir", log_dir,
+        "--health-interval-s", str(knobs.get("health_interval_s", 0.25)),
+        "--fail-threshold", str(knobs.get("fail_threshold", 2)),
+        "--pass-threshold", str(knobs.get("pass_threshold", 1)),
+        "--request-timeout-s", str(knobs.get("request_timeout_s", 120.0)),
+    ]
+    log_handle = open(os.path.join(log_dir, "gateway_stdout.log"), "ab")
+    proc = subprocess.Popen(
+        argv, cwd=_REPO_ROOT, stdout=log_handle, stderr=subprocess.STDOUT
+    )
+    log_handle.close()
+    port = _wait_port_file(port_file, proc, timeout_s=30.0)
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def _kill_quiet(proc) -> None:
+    if proc is None or proc.poll() is not None:
+        return
+    try:
+        proc.kill()
+        proc.wait(timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+
+
+def _adapt_payload(seed: int):
+    from ..data.synthetic import synthetic_batch
+
+    b = synthetic_batch(1, 5, 2, 3, (28, 28, 1), seed=seed)
+    return (
+        {"x_support": b["x_support"][0].tolist(),
+         "y_support": b["y_support"][0].tolist()},
+        b["x_target"][0].reshape((-1, 28, 28, 1)).tolist(),
+    )
+
+
+def _run_gateway_episode(
+    ep: Episode, work_dir: Optional[str] = None, template_run: Optional[str] = None
+) -> List[str]:
+    """One cross-process fleet drill: a REAL gateway subprocess fronting
+    REAL serve-backend subprocesses, driven over the wire. Returns
+    violations (empty = green). ``template_run`` (a previously built run
+    dir) lets the tier-1 tests share one checkpoint across drills."""
+    import tempfile
+
+    violations: List[str] = []
+    root = tempfile.mkdtemp(prefix=f"chaos_{ep.kind.replace('-', '_')}_",
+                            dir=work_dir)
+    procs: List[Any] = []
+    try:
+        if ep.kind == "gateway-kill9-backend":
+            violations += _drill_kill9(root, template_run, procs)
+        elif ep.kind == "gateway-drain-rehydrate":
+            violations += _drill_drain_rehydrate(root, template_run, procs)
+        elif ep.kind == "gateway-rolling-restart":
+            violations += _drill_rolling_restart(root, template_run, procs)
+        else:
+            violations.append(f"unknown gateway episode kind {ep.kind!r}")
+    except Exception as exc:  # noqa: BLE001 — a drill crash is the finding
+        violations.append(f"{ep.kind} drill crashed: {type(exc).__name__}: {exc}")
+    finally:
+        for proc in procs:
+            _kill_quiet(proc)
+    return violations
+
+
+def _spawn_fleet(root: str, template_run: Optional[str], procs: List[Any], n: int):
+    """n backends (fixed ports, warm) + one gateway; returns
+    (run_dirs, ports, backend_procs, gateway_proc, gateway_url, log_dir)."""
+    template = template_run or make_serving_run_dir(root, "template")
+    run_dirs, ports, backend_procs = [], [], []
+    for i in range(n):
+        run_dir = make_serving_run_dir(root, f"b{i}", template=template)
+        port = _free_port()
+        proc, port_file = spawn_serve_backend(run_dir, port=port)
+        procs.append(proc)
+        run_dirs.append(run_dir)
+        ports.append(port)
+        backend_procs.append(proc)
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    for url, proc in zip(urls, backend_procs):
+        # past "warming": the AOT prewarm must land before the drill clock
+        _wait_http_ok(url + "/healthz", timeout_s=300.0, proc=proc)
+    gw_log_dir = os.path.join(root, "gateway", "logs")
+    gw_proc, gw_url = spawn_gateway(urls, gw_log_dir)
+    procs.append(gw_proc)
+    _wait_http_ok(gw_url + "/healthz", timeout_s=30.0, proc=gw_proc)
+    return run_dirs, ports, backend_procs, gw_proc, gw_url, gw_log_dir
+
+
+def _drill_kill9(root, template_run, procs) -> List[str]:
+    """kill -9 one backend mid-flight: the gateway routes around it within
+    the hysteresis window (availability never reaches zero), the displaced
+    session re-adapts — NEVER a stale answer — and the membership flap is
+    events-resolvable."""
+    violations: List[str] = []
+    run_dirs, ports, backends, gw_proc, gw_url, gw_logs = _spawn_fleet(
+        root, template_run, procs, n=2
+    )
+    support, query = _adapt_payload(31)
+    code, body, headers = _http_json(gw_url + "/adapt", support)
+    if code != 200:
+        return [f"warm adapt failed: {code} {body}"]
+    aid = body["adaptation_id"]
+    owner = headers.get("X-Gateway-Backend")  # "b0" / "b1"
+    code, body, _ = _http_json(
+        gw_url + "/predict", {"adaptation_id": aid, "x_query": query}
+    )
+    if code != 200:
+        return [f"warm predict failed: {code} {body}"]
+    probs_before = body["probs"]
+    owner_idx = int(owner[1:])
+    os.kill(backends[owner_idx].pid, 9)  # SIGKILL: no drain, no goodbye
+    # drive load THROUGH the kill: fresh adapts must keep succeeding (the
+    # gateway retries connection failures against the survivor), so
+    # availability never reaches zero
+    ok = fail = 0
+    deadline = time.monotonic() + 20.0
+    seed = 100
+    while time.monotonic() < deadline:
+        s, _ = _adapt_payload(seed)
+        seed += 1
+        try:
+            code, _, _ = _http_json(gw_url + "/adapt", s, timeout_s=30.0)
+        except OSError:
+            code = None
+        if code == 200:
+            ok += 1
+        else:
+            fail += 1
+        if ok >= 6:
+            break
+        time.sleep(0.2)
+    if ok < 6:
+        violations.append(
+            f"availability lost after kill -9: {ok} ok / {fail} failed"
+        )
+    # the displaced session must NOT be served stale: predict resolves 404
+    # (the survivor never adapted it), then a re-adapt + predict must be
+    # bit-identical to the pre-kill answer
+    code = None
+    for _ in range(20):
+        try:
+            code, body, _ = _http_json(
+                gw_url + "/predict", {"adaptation_id": aid, "x_query": query},
+                timeout_s=30.0,
+            )
+        except OSError:
+            code = None
+        if code in (200, 404):
+            break
+        time.sleep(0.3)
+    if code == 200:
+        violations.append(
+            "displaced session predict returned 200 without re-adapt — "
+            "possible stale/wrong answer after backend death"
+        )
+    elif code != 404:
+        violations.append(f"displaced predict never resolved (last {code})")
+    code, body, headers = _http_json(gw_url + "/adapt", support, timeout_s=60.0)
+    if code != 200:
+        violations.append(f"re-adapt failed: {code}")
+    else:
+        if headers.get("X-Gateway-Backend") == owner:
+            violations.append("re-adapt routed to the killed backend")
+        code, body, _ = _http_json(
+            gw_url + "/predict", {"adaptation_id": aid, "x_query": query},
+            timeout_s=60.0,
+        )
+        if code != 200 or body.get("probs") != probs_before:
+            violations.append(
+                "post-failover predictions differ from the healthy fleet's"
+            )
+    # membership: the dead backend is OUT and the flap is events-resolvable
+    code, metrics, _ = _http_json(gw_url + "/metrics", timeout_s=30.0)
+    rows = {b["backend"]: b for b in metrics.get("backends", [])}
+    if rows.get(owner, {}).get("in") is not False:
+        violations.append(f"dead backend {owner} still IN: {rows.get(owner)}")
+    events_path = os.path.join(gw_logs, "events.jsonl")
+    flaps = []
+    if os.path.exists(events_path):
+        with open(events_path) as f:
+            for line in f:
+                if line.strip():
+                    rec = json.loads(line)
+                    if rec.get("event") == "backend_out":
+                        flaps.append(rec.get("backend"))
+    if owner not in flaps:
+        violations.append(
+            f"backend_out event for {owner} missing from gateway events.jsonl"
+        )
+    return violations
+
+
+def _drill_drain_rehydrate(root, template_run, procs) -> List[str]:
+    """SIGTERM mid-load: zero dropped in-flight requests, clean rc 0, and a
+    digest-verified spill -> rehydrate round-trip proven by a post-restart
+    predict WITHOUT re-adapt (the session survived the restart)."""
+    violations: List[str] = []
+    template = template_run or make_serving_run_dir(root, "template")
+    run_dir = make_serving_run_dir(root, "b0", template=template)
+    port = _free_port()
+    # injected 0.5s dispatch delay: requests are genuinely in flight when
+    # the SIGTERM lands, so "zero dropped" is actually exercised
+    env = {"HTYMP_FAULTS": "serving.dispatch=delay:delay_s=0.5,p=1.0"}
+    proc, _ = spawn_serve_backend(run_dir, port=port, env_extra=env)
+    procs.append(proc)
+    url = f"http://127.0.0.1:{port}"
+    _wait_http_ok(url + "/healthz", timeout_s=300.0, proc=proc)
+    gw_logs = os.path.join(root, "gateway", "logs")
+    gw_proc, gw_url = spawn_gateway([url], gw_logs)
+    procs.append(gw_proc)
+    _wait_http_ok(gw_url + "/healthz", timeout_s=30.0, proc=gw_proc)
+    support, query = _adapt_payload(47)
+    code, body, _ = _http_json(gw_url + "/adapt", support, timeout_s=60.0)
+    if code != 200:
+        return [f"warm adapt failed: {code} {body}"]
+    aid = body["adaptation_id"]
+    code, body, _ = _http_json(
+        gw_url + "/predict", {"adaptation_id": aid, "x_query": query},
+        timeout_s=60.0,
+    )
+    if code != 200:
+        return [f"warm predict failed: {code}"]
+    probs_before = body["probs"]
+    # in-flight load: 3 concurrent predicts (0.5s dispatch each, serialized
+    # by the worker) — then SIGTERM lands mid-flight
+    results: List[Any] = []
+    lock = threading.Lock()
+
+    def one_predict():
+        try:
+            c, b, _ = _http_json(
+                gw_url + "/predict", {"adaptation_id": aid, "x_query": query},
+                timeout_s=90.0,
+            )
+        except OSError as exc:
+            c, b = None, {"error": str(exc)}
+        with lock:
+            results.append((c, b))
+
+    threads = [threading.Thread(target=one_predict) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)  # let them reach the backend and queue/dispatch
+    proc.send_signal(15)  # SIGTERM: graceful drain
+    # a NEW request during the drain must be refused with Retry-After,
+    # never silently dropped (single-backend fleet: the gateway has
+    # nowhere to retry it)
+    try:
+        code, _, headers = _http_json(
+            gw_url + "/predict", {"adaptation_id": aid, "x_query": query},
+            timeout_s=60.0,
+        )
+        if code == 200:
+            pass  # raced ahead of the drain flag — legitimate
+        elif code in (
+            exit_codes.HTTP_UNAVAILABLE, exit_codes.HTTP_TOO_MANY_REQUESTS
+        ):
+            if "Retry-After" not in headers:
+                violations.append(f"drain-window {code} without Retry-After")
+        else:
+            violations.append(f"drain-window request got undocumented {code}")
+    except OSError:
+        violations.append("drain-window request got a dropped connection")
+    for t in threads:
+        t.join(timeout=120)
+    dropped = [r for r in results if r[0] != 200]
+    if len(results) != 3 or dropped:
+        violations.append(
+            f"in-flight requests dropped by drain: {results}"
+        )
+    try:
+        rc = proc.wait(timeout=90)
+    except subprocess.TimeoutExpired:
+        violations.append("drained backend never exited")
+        return violations
+    if rc != 0:
+        violations.append(f"clean drain exited rc {rc} (want 0)")
+    sessions_dir = os.path.join(run_dir, "saved_models", "sessions")
+    spilled = (
+        [n for n in os.listdir(sessions_dir) if n.startswith("session_")]
+        if os.path.isdir(sessions_dir)
+        else []
+    )
+    if not spilled:
+        violations.append("drain spilled no sessions")
+    # respawn the SAME run dir on the SAME port: the replica must rehydrate
+    # and serve the old session without a re-adapt
+    proc2, _ = spawn_serve_backend(run_dir, port=port)
+    procs.append(proc2)
+    _wait_http_ok(url + "/healthz", timeout_s=300.0, proc=proc2)
+    # wait for the gateway to readmit it
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        code, m, _ = _http_json(gw_url + "/metrics", timeout_s=10.0)
+        if m.get("backends_in") == 1:
+            break
+        time.sleep(0.3)
+    code, body, _ = _http_json(
+        gw_url + "/predict", {"adaptation_id": aid, "x_query": query},
+        timeout_s=90.0,
+    )
+    if code != 200:
+        violations.append(
+            f"post-restart predict for the spilled session failed: {code} "
+            "(rehydration lost the session)"
+        )
+    elif body.get("probs") != probs_before:
+        violations.append("rehydrated session served DIFFERENT predictions")
+    code, metrics, _ = _http_json(url + "/metrics", timeout_s=30.0)
+    sessions = metrics.get("sessions") or {}
+    if int(sessions.get("rehydrated", 0)) < 1:
+        violations.append(f"backend reports no rehydrated sessions: {sessions}")
+    cache = metrics.get("cache") or {}
+    if int(cache.get("hits", 0)) < 1:
+        violations.append(f"rehydrated predict was not a cache hit: {cache}")
+    return violations
+
+
+def _drill_rolling_restart(root, template_run, procs) -> List[str]:
+    """Full rolling restart under load via scripts/rolling_restart.py: both
+    backends drained + respawned warm one at a time, the fleet never
+    refuses all traffic, and every non-200 the driver saw resolves to a
+    gateway access line by request id."""
+    violations: List[str] = []
+    run_dirs, ports, backends, gw_proc, gw_url, gw_logs = _spawn_fleet(
+        root, template_run, procs, n=2
+    )
+    # background driver: steady adapt/predict mix; record every outcome
+    stop = threading.Event()
+    outcomes: List[Any] = []
+    lock = threading.Lock()
+
+    def drive():
+        seed = 500
+        aid = None
+        while not stop.is_set():
+            try:
+                if aid is None or seed % 3 == 0:
+                    s, q = _adapt_payload(seed % 40)
+                    c, b, h = _http_json(gw_url + "/adapt", s, timeout_s=60.0)
+                    if c == 200:
+                        aid = b.get("adaptation_id")
+                else:
+                    _, q = _adapt_payload(seed % 40)
+                    c, b, h = _http_json(
+                        gw_url + "/predict",
+                        {"adaptation_id": aid, "x_query": q},
+                        timeout_s=60.0,
+                    )
+                    if c == 404:
+                        aid = None  # displaced session: re-adapt next turn
+                rid = h.get("X-Request-Id")
+            except OSError as exc:
+                c, rid = None, None
+            with lock:
+                outcomes.append((c, rid))
+            seed += 1
+            stop.wait(0.15)
+
+    driver = threading.Thread(target=drive, daemon=True)
+    driver.start()
+    time.sleep(1.0)
+    # reap each original backend the moment its drain exits: without this
+    # they linger as zombies of THIS process and rolling_restart's
+    # pid-liveness probe (os.kill(pid, 0)) would never see them die
+    for proc in backends:
+        threading.Thread(target=proc.wait, daemon=True).start()
+    fleet_spec = [
+        {
+            "url": f"http://127.0.0.1:{port}",
+            "pid": proc.pid,
+            "respawn": backend_spawn_argv(run_dir, port),
+            "cwd": _REPO_ROOT,
+            "log": os.path.join(run_dir, "serve_stdout.log"),
+        }
+        for run_dir, port, proc in zip(run_dirs, ports, backends)
+    ]
+    fleet_path = os.path.join(root, "fleet.json")
+    with open(fleet_path, "w") as f:
+        json.dump(fleet_spec, f)
+    roll = subprocess.run(
+        [
+            sys.executable, os.path.join(_REPO_ROOT, "scripts", "rolling_restart.py"),
+            "--fleet", fleet_path, "--drain-timeout-s", "90",
+            "--warm-timeout-s", "300",
+        ],
+        cwd=_REPO_ROOT,
+        env=_child_env(1),
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    time.sleep(1.0)
+    stop.set()
+    driver.join(timeout=120)
+    verdict = None
+    for line in reversed(roll.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if parsed.get("rolling_restart"):
+            verdict = parsed
+            break
+    if roll.returncode != 0 or verdict is None or not verdict.get("ok"):
+        violations.append(
+            f"rolling restart failed: rc {roll.returncode} verdict {verdict} "
+            f"stderr tail: {roll.stderr[-500:]}"
+        )
+    else:
+        # the respawned pids are tracked for cleanup
+        for row in verdict["rows"]:
+            procs.append(_FakeProc(row.get("new_pid")))
+    with lock:
+        seen = list(outcomes)
+    oks = sum(1 for c, _ in seen if c == 200)
+    conn_drops = sum(1 for c, _ in seen if c is None)
+    if oks < 5:
+        violations.append(f"fleet served only {oks} oks through the roll: {seen}")
+    if conn_drops:
+        violations.append(
+            f"{conn_drops} dropped connections during the roll (gateway must "
+            "absorb backend restarts)"
+        )
+    # every non-200 the driver saw resolves to a gateway access line
+    access_path = os.path.join(gw_logs, "access.jsonl")
+    logged = set()
+    if os.path.exists(access_path):
+        with open(access_path) as f:
+            for line in f:
+                if line.strip():
+                    try:
+                        logged.add(json.loads(line).get("trace_id"))
+                    except ValueError:
+                        pass
+    for c, rid in seen:
+        if c is not None and c != 200:
+            if rid is None:
+                violations.append(f"non-200 ({c}) without X-Request-Id")
+            elif rid not in logged:
+                violations.append(
+                    f"non-200 ({c}) request {rid} has no gateway access line"
+                )
+    return violations
+
+
+class _FakeProc:
+    """pid-only handle so cleanup can SIGKILL processes we did not spawn
+    directly (rolling_restart's respawned backends)."""
+
+    def __init__(self, pid):
+        self.pid = pid
+
+    def poll(self):
+        if self.pid is None:
+            return 0
+        try:
+            os.kill(self.pid, 0)
+        except (ProcessLookupError, PermissionError):
+            return 0
+        return None
+
+    def kill(self):
+        if self.pid is not None:
+            try:
+                os.kill(self.pid, 9)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def wait(self, timeout=None):
+        deadline = time.monotonic() + (timeout or 0)
+        while self.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        return 0
+
+
+# ---------------------------------------------------------------------------
 # the campaign
 # ---------------------------------------------------------------------------
 
@@ -724,6 +1436,10 @@ def run_campaign(
 
         if ep.mode == "serve":
             ep_viol += _run_serve_episode(ep)
+        elif ep.mode == "gateway":
+            # cross-process fleet drill: real gateway subprocess + real
+            # serve-backend subprocesses, all state under work_dir
+            ep_viol += _run_gateway_episode(ep, work_dir=work_dir)
         else:
             if (
                 any("sigterm" in f for f in ep.faults)
@@ -858,6 +1574,8 @@ def run_campaign(
             "serving never 200s a shed/failed payload",
             "telemetry.jsonl well-formed + exported traces balanced",
             "every non-200 HTTP response has an access-log line with its request id",
+            "fleet: availability survives backend death; drain drops nothing; "
+            "sessions rehydrate digest-verified, never stale",
         ],
         "episode_results": results,
         "elapsed_s": round(time.time() - t0, 1),

@@ -26,12 +26,16 @@ from .batcher import MicroBatcher, QueueFullError  # noqa: F401
 from .cache import AdaptedWeightCache, support_digest, tree_bytes  # noqa: F401
 from .engine import AdaptationEngine  # noqa: F401
 from .errors import ServiceUnavailableError, UnknownAdaptationError  # noqa: F401
+from .gateway import Gateway, make_gateway_server, rendezvous_score  # noqa: F401
 from .metrics import EventCounters, LatencyStats  # noqa: F401
 from .pool import EnginePool, EngineReplica  # noqa: F401
 from .router import NoRoutableReplicaError, Router  # noqa: F401
 from .server import (  # noqa: F401
     ServingFrontend,
+    drain_exit_code,
     frontend_from_run_dir,
     make_http_server,
+    run_server,
     serve_forever,
 )
+from .sessions import SessionStore  # noqa: F401

@@ -165,14 +165,17 @@ class MicroBatcher:
                 "in_flight": self.in_flight,
             }
 
-    def close(self) -> None:
-        """Flush everything still queued, then stop the worker."""
+    def close(self, join_timeout_s: float = None) -> None:
+        """Flush everything still queued, then stop the worker.
+        ``join_timeout_s`` bounds the wait (the drain-deadline-exceeded
+        path: a worker parked in a hung dispatch must not also hang the
+        exiting process — it is a daemon thread and dies with it)."""
         with self._wake:
             if self._closed:
                 return
             self._closed = True
             self._wake.notify()
-        self._worker.join()
+        self._worker.join(timeout=join_timeout_s)
 
     # ------------------------------------------------------------------
 

@@ -97,7 +97,10 @@ class AdaptedWeightCache:
             ctx.cache_hit = True
         return entry[0]
 
-    def put(self, key: CacheKey, tree: Any) -> None:
+    def put(self, key: CacheKey, tree: Any, age_s: float = 0.0) -> None:
+        """``age_s`` back-dates the entry (rehydration after a drain: a
+        session restored with 1s of TTL budget left must expire in 1s, not
+        get a fresh full TTL)."""
         nbytes = tree_bytes(tree)
         now = self._clock()
         with self._lock:
@@ -110,12 +113,25 @@ class AdaptedWeightCache:
                 # everything and still bust the bound — refuse
                 self.evictions += 1
                 return
-            self._entries[key] = (tree, nbytes, now)
+            self._entries[key] = (tree, nbytes, now - float(age_s))
             self._bytes += nbytes
             while self._bytes > self.max_bytes:
                 _, (_, evicted_bytes, _) = self._entries.popitem(last=False)
                 self._bytes -= evicted_bytes
                 self.evictions += 1
+
+    def snapshot_entries(self):
+        """``[(key, tree, age_s)]`` of every live (unexpired) entry, LRU
+        order, under the lock — the graceful-drain spill's source
+        (serving/sessions.py). ``age_s`` lets the spill preserve each
+        entry's ORIGINAL TTL budget across a restart."""
+        now = self._clock()
+        with self._lock:
+            self._expire_locked(now)
+            return [
+                (key, tree, now - inserted)
+                for key, (tree, _, inserted) in self._entries.items()
+            ]
 
     def __contains__(self, key: CacheKey) -> bool:
         with self._lock:

@@ -226,9 +226,9 @@ class EngineReplica:
             out["death_reason"] = reason
         return out
 
-    def close(self) -> None:
-        self.adapt_batcher.close()
-        self.predict_batcher.close()
+    def close(self, join_timeout_s: float = None) -> None:
+        self.adapt_batcher.close(join_timeout_s)
+        self.predict_batcher.close(join_timeout_s)
 
 
 class EnginePool:
@@ -350,6 +350,6 @@ class EnginePool:
 
         return prewarm_pool(self, **kwargs)
 
-    def close(self) -> None:
+    def close(self, join_timeout_s: float = None) -> None:
         for r in self.replicas:
-            r.close()
+            r.close(join_timeout_s)

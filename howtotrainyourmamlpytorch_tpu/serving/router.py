@@ -24,23 +24,17 @@ routed-around/shed counters) is guarded by one lock. Scoring itself is
 pure (hashlib over immutable fields) and runs outside it.
 """
 
-import hashlib
 import threading
 from typing import Any, Dict, List, Optional
 
 from ..exit_codes import HTTP_TOO_MANY_REQUESTS
 from .errors import ServiceUnavailableError
+
+# THE one rendezvous implementation, shared with the multi-host gateway
+# (serving/gateway.py, import-light) so in-process affinity and cross-host
+# affinity can never disagree about where a session lives
+from .gateway import rendezvous_score  # noqa: F401 — re-exported
 from .pool import EngineReplica
-
-
-def rendezvous_score(key: str, replica_index: int) -> int:
-    """Deterministic (key, replica) weight: leading 64 bits of
-    blake2b(key | replica). Stable across processes and runs — every
-    router of a fleet agrees where a session lives."""
-    h = hashlib.blake2b(
-        f"{key}|{replica_index}".encode(), digest_size=8
-    )
-    return int.from_bytes(h.digest(), "big")
 
 
 class NoRoutableReplicaError(ServiceUnavailableError):

@@ -21,6 +21,7 @@ whose single worker serializes device dispatch.
 
 import json
 import os
+import signal
 import sys
 import threading
 import time
@@ -32,7 +33,7 @@ import jax
 import numpy as np
 
 from ..config import Config, ResilienceConfig, ServingConfig
-from ..exit_codes import HTTP_DEADLINE, HTTP_UNAVAILABLE
+from ..exit_codes import DRAIN_DEADLINE, HTTP_DEADLINE, HTTP_UNAVAILABLE, OK
 from ..observability import TelemetryHub
 from ..observability.context import (
     AccessLog,
@@ -55,7 +56,7 @@ from .engine import AdaptationEngine
 from .errors import ServiceUnavailableError, UnknownAdaptationError  # noqa: F401
 from .metrics import EventCounters, LatencyStats
 from .pool import EnginePool
-from .router import Router
+from .router import Router, rendezvous_score
 
 
 class ServingFrontend:
@@ -104,6 +105,11 @@ class ServingFrontend:
         # from_run_dir / serve.py / loadgen pass one) AND observability is
         # on — disabled, the request path stays zero-file.
         self.access_log: Optional[AccessLog] = None
+        # structured serving events (replica deaths, drain milestones,
+        # session spill/rehydrate) land in <access_log_dir>/events.jsonl —
+        # same zero-file contract as the access log: only with a log dir
+        # AND observability on, so the disabled build stays bit-identical
+        self.events = None
         if self.hub.enabled and access_log_dir:
             obs_cfg = getattr(engine.cfg, "observability", None)
             if getattr(obs_cfg, "access_log", True):
@@ -111,6 +117,9 @@ class ServingFrontend:
                     access_log_dir,
                     sample=getattr(obs_cfg, "access_log_sample", 1.0),
                 )
+            from ..experiment.storage import EventLog
+
+            self.events = EventLog(access_log_dir)
         if self.hub.enabled:
             # trace the engine's device dispatches and both batchers' flushes
             # through the hub's tracer (engines built standalone keep their
@@ -175,6 +184,33 @@ class ServingFrontend:
             self.hub.add_provider("router", self.router.stats)
         self._started = time.monotonic()
         self._closed = False
+        # --- graceful drain state (begin_drain / run_server) -------------
+        # one lock guards the draining flag and the in-flight request count;
+        # the condition lets the drain thread sleep until the count reaches
+        # zero instead of polling
+        self._drain_lock = threading.Lock()
+        self._drain_zero = threading.Condition(self._drain_lock)
+        self._draining = False
+        self._inflight = 0
+        self._drain_info: Dict[str, Any] = {}
+        # set once the FIRST drain fully completes (verdict recorded) —
+        # a second SIGTERM blocks on it instead of racing ahead with an
+        # empty verdict and shutting the server down mid-drain
+        self._drain_done = threading.Event()
+        # --- session spill/rehydrate (serving/sessions.py) ----------------
+        # run-dir engines spill hot adapted sessions at drain and rehydrate
+        # them here at startup, so a rolling restart keeps its sessions warm
+        self.session_store = None
+        self._session_stats: Dict[str, int] = {}
+        if getattr(self.serving, "session_spill", True) and getattr(
+            engine, "save_dir", None
+        ):
+            from .sessions import SessionStore
+
+            self.session_store = SessionStore(
+                os.path.join(engine.save_dir, "sessions")
+            )
+            self._rehydrate_sessions()
         # --- AOT prewarm (Config.aot; compile/aot.py) --------------------
         # compile the full (bucket x batch-bucket) serving grid before (or,
         # background, while) the frontend accepts work: /healthz answers
@@ -457,6 +493,15 @@ class ServingFrontend:
         replica = self.pool.replicas[index]
         replica.kill(reason)
         self.counters.inc("replica_deaths")
+        # the death is an events.jsonl event too (not only an access line):
+        # obs_report answers "when did r1 die and who absorbed it" from the
+        # run dir after the fact, long after /metrics is gone
+        self._event(
+            "replica_death",
+            replica=index,
+            reason=reason,
+            routable=sum(1 for r in self.pool.replicas if r.routable()),
+        )
         if self.access_log is not None:
             ctx = new_request_context()
             ctx.replica = index
@@ -465,10 +510,190 @@ class ServingFrontend:
                 replica=index, reason=reason,
             )
 
+    # ------------------------------------------------------------------
+    # graceful drain + session spill/rehydrate
+    # ------------------------------------------------------------------
+
+    def _event(self, name: str, **fields: Any) -> None:
+        """One structured serving event into <logs>/events.jsonl (no-op
+        without a log dir); a failed append must never fail a request."""
+        if self.events is None:
+            return
+        try:
+            self.events.append(
+                {"ts": time.time(), "event": name, "component": "serving", **fields}
+            )
+        except OSError:
+            pass
+
+    def _enter_request(self) -> None:
+        """Admission gate + in-flight accounting: a request arriving after
+        drain began is refused 503 + Retry-After (the gateway/load balancer
+        already stopped routing here; this catches the race)."""
+        with self._drain_lock:
+            if self._draining:
+                raise ServiceUnavailableError(
+                    "backend is draining (shutting down); retry against "
+                    "another backend",
+                    retry_after_s=self.resilience.shed_retry_after_s,
+                )
+            self._inflight += 1
+
+    def _exit_request(self) -> None:
+        with self._drain_lock:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._drain_zero.notify_all()
+
+    def _wait_inflight_drained(self, deadline_s: float) -> bool:
+        """Block until every in-flight request (queued work included — its
+        caller blocks in ``Future.result`` and so counts) completes, bounded
+        by ``deadline_s``. True = drained clean."""
+        end = time.monotonic() + deadline_s
+        with self._drain_lock:
+            while self._inflight > 0:
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._drain_zero.wait(timeout=remaining)
+        return True
+
+    def draining(self) -> bool:
+        with self._drain_lock:
+            return self._draining
+
+    def http_scope(self):
+        """Context manager the HTTP handler wraps one WHOLE request in
+        (parse -> frontend call -> response write): the drain's in-flight
+        gate must cover the socket write, or a drain completing between
+        the frontend method returning and the handler serializing the body
+        would let the process exit mid-write — a request counted completed
+        that the client saw as a connection reset. Nested with the
+        frontend methods' own gate (both count; both release)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            self._enter_request()
+            try:
+                yield
+            finally:
+                self._exit_request()
+
+        return _scope()
+
+    def begin_drain(
+        self, deadline_s: Optional[float] = None, reason: str = "sigterm"
+    ) -> Dict[str, Any]:
+        """Graceful drain: stop taking new work (healthz flips to
+        ``draining`` — 503 — and new requests get 503 + Retry-After), let
+        in-flight AND queued work complete under ``deadline_s`` (default
+        ``serving.drain_deadline_s``), spill hot sessions to the run dir,
+        close batchers/telemetry/logs cleanly. Idempotent: a second SIGTERM
+        returns the first drain's verdict. Returns the drain info dict;
+        ``deadline_exceeded`` means the supervisor should exit
+        ``exit_codes.DRAIN_DEADLINE``."""
+        if deadline_s is None:
+            deadline_s = float(getattr(self.serving, "drain_deadline_s", 30.0))
+        with self._drain_lock:
+            already = self._draining
+            self._draining = True
+        if already:
+            # a second SIGTERM must WAIT for the first drain's verdict —
+            # returning early with an empty dict would let run_server shut
+            # the server down mid-drain and report a lossy exit as clean
+            self._drain_done.wait(timeout=deadline_s + 30.0)
+            with self._drain_lock:
+                return dict(self._drain_info)
+        t0 = time.monotonic()
+        inflight_at_start = self._inflight
+        self._event(
+            "drain_begin", reason=reason, deadline_s=deadline_s,
+            inflight=inflight_at_start,
+        )
+        drained = self._wait_inflight_drained(deadline_s)
+        spilled = 0
+        spill_error = None
+        if self.session_store is not None:
+            try:
+                spilled = self._spill_sessions()
+            except Exception as exc:  # noqa: BLE001 — spill is best-effort
+                spill_error = f"{type(exc).__name__}: {exc}"
+        info: Dict[str, Any] = {
+            "ok": drained and spill_error is None,
+            "deadline_exceeded": not drained,
+            "deadline_s": deadline_s,
+            "inflight_at_drain": inflight_at_start,
+            "spilled_sessions": spilled,
+            "seconds": round(time.monotonic() - t0, 3),
+        }
+        if spill_error is not None:
+            info["spill_error"] = spill_error
+        with self._drain_lock:
+            self._drain_info = info
+        self._drain_done.set()
+        self._event("drain_complete", **info)
+        # bounded close on the deadline path: a worker parked in a hung
+        # dispatch must not also hang the exiting process
+        self.close(join_timeout_s=None if drained else 2.0)
+        return info
+
+    def _spill_sessions(self) -> int:
+        """Spill every live adapted session (all replicas' caches) to the
+        run dir, content-addressed + digest-wrapped (serving/sessions.py)."""
+        count = 0
+        ttl_s = float(self.serving.cache_ttl_s)
+        for replica in self.pool.replicas:
+            for key, tree, age_s in replica.cache.snapshot_entries():
+                fingerprint, digest = key
+                if fingerprint != self.engine.fingerprint:
+                    continue
+                self.session_store.spill(
+                    digest, tree, fingerprint, age_s=age_s, ttl_s=ttl_s
+                )
+                count += 1
+        if count:
+            self._event("sessions_spilled", count=count, dir=self.session_store.root)
+        return count
+
+    def _rehydrate_sessions(self) -> None:
+        """Load spilled sessions (digest-verified, fingerprint-matched,
+        TTL-honored — serving/sessions.py) into the replica each key is
+        rendezvous-affine to, so the router finds them exactly where it
+        will look. Anything unsafe is ignored: the fallback is the honest
+        404 + re-adapt, never a stale answer."""
+        entries, stats = self.session_store.load_all(
+            fingerprint=self.engine.fingerprint,
+            template=self.engine.state.params,
+        )
+        for digest, tree, lived_s in entries:
+            replica = max(
+                self.pool.replicas,
+                key=lambda r: rendezvous_score(digest, r.index),
+            )
+            # back-date by the TTL budget already consumed: a restart must
+            # never extend a session's original expiry
+            replica.cache.put(self._cache_key(digest), tree, age_s=lived_s)
+        self._session_stats = dict(stats, rehydrated=stats["loaded"])
+        if any(stats.values()):
+            self._event("sessions_rehydrated", **stats)
+            print(
+                "serving sessions: rehydrated "
+                f"{stats['loaded']} (stale {stats['stale']}, corrupt "
+                f"{stats['corrupt']}, foreign {stats['foreign']})",
+                flush=True,
+            )
+
     def adapt(self, x_support, y_support, ctx: Optional[RequestContext] = None) -> Dict[str, Any]:
         ctx = self._request_ctx(ctx)
         t0 = time.monotonic()
+        entered = False
         try:
+            # drain gate + in-flight accounting: a request that passes here
+            # is guaranteed to complete (or fail honestly) before a
+            # graceful drain lets the process exit
+            self._enter_request()
+            entered = True
             # the request's flow STARTS here (ph "s"); the batcher flush
             # steps it ("t") and the engine dispatch finishes it ("f") — one
             # linked arc HTTP thread -> worker flush -> device dispatch
@@ -501,6 +726,9 @@ class ServingFrontend:
             outcome, status = self._failure_of(exc)
             self._record_access(ctx, "adapt", outcome, status, time.monotonic() - t0)
             raise
+        finally:
+            if entered:
+                self._exit_request()
         elapsed = time.monotonic() - t0
         self.latency.record("adapt_cached" if cached else "adapt", elapsed)
         self._record_access(ctx, "adapt", "ok", 200, elapsed)
@@ -518,7 +746,10 @@ class ServingFrontend:
     def predict(self, adaptation_id: str, x_query, ctx: Optional[RequestContext] = None) -> np.ndarray:
         ctx = self._request_ctx(ctx)
         t0 = time.monotonic()
+        entered = False
         try:
+            self._enter_request()
+            entered = True
             with self.hub.span(
                 "serve.predict", flows=flow_start(ctx),
                 trace=ctx.trace_id if ctx else None,
@@ -551,6 +782,9 @@ class ServingFrontend:
             outcome, status = self._failure_of(exc)
             self._record_access(ctx, "predict", outcome, status, time.monotonic() - t0)
             raise
+        finally:
+            if entered:
+                self._exit_request()
         elapsed = time.monotonic() - t0
         self.latency.record("predict", elapsed)
         self._record_access(ctx, "predict", "ok", 200, elapsed)
@@ -592,10 +826,17 @@ class ServingFrontend:
                 degraded.append(f"breaker_{replica.breaker.state}{tag}")
         routable = sum(1 for r in self.pool.replicas if r.routable())
         prewarm = self.prewarm_status()
-        # "warming" is its own state, not a degradation: the replica is
-        # healthy but would eat cold XLA compiles — the HTTP layer 503s it
-        # (like breaker-open) so orchestrators hold traffic until warm
-        if prewarm["status"] == "warming":
+        # the status field is the MACHINE-READABLE membership contract a
+        # gateway/orchestrator switches on — exactly one of four values,
+        # schema-pinned by test: "ok" (route), "degraded" (route unless
+        # routable==0 — the body names what is down), "warming" (alive,
+        # hold NEW traffic until the AOT prewarm lands), "draining" (alive,
+        # finishing in-flight work, never route NEW work — takes precedence
+        # over everything: a draining replica is leaving no matter how
+        # healthy it looks)
+        if self.draining():
+            status = "draining"
+        elif prewarm["status"] == "warming":
             status = "warming"
         else:
             status = "degraded" if degraded else "ok"
@@ -638,6 +879,19 @@ class ServingFrontend:
             },
             "uptime_s": round(time.monotonic() - self._started, 1),
         }
+        with self._drain_lock:
+            out["drain"] = {
+                "draining": self._draining,
+                "inflight": self._inflight,
+                **self._drain_info,
+            }
+        if self.session_store is not None:
+            # rehydrate verdicts + what is parked on disk right now — the
+            # spill->rehydrate round-trip is a scrape-able number
+            out["sessions"] = {
+                **self._session_stats,
+                "pending_on_disk": self.session_store.pending(),
+            }
         if self.access_log is not None:
             out["access_log"] = self.access_log.stats()
         if self._memory is not None:
@@ -651,15 +905,17 @@ class ServingFrontend:
         registry that backs every serving number."""
         return prometheus_text(self.hub.registry)
 
-    def close(self) -> None:
+    def close(self, join_timeout_s: float = None) -> None:
         if self._closed:
             return
         self._closed = True
         for wd in self._watchdogs:
             wd.stop()
-        self.pool.close()
+        self.pool.close(join_timeout_s)
         if self.access_log is not None:
             self.access_log.close()
+        if self.events is not None:
+            self.events.close()
 
 
 def frontend_from_run_dir(
@@ -767,7 +1023,7 @@ class _Handler(BaseHTTPRequestHandler):
                 code = (
                     HTTP_UNAVAILABLE
                     if health["routable"] == 0
-                    or health["status"] == "warming"
+                    or health["status"] in ("warming", "draining")
                     else 200
                 )
                 if code != 200:
@@ -795,31 +1051,35 @@ class _Handler(BaseHTTPRequestHandler):
         frontend: ServingFrontend = self.server.frontend  # type: ignore[attr-defined]
         ctx = self._begin_request(frontend)
         try:
-            # fault seam for handler-level drills (raise -> 500, delay) —
-            # fired AFTER the body is drained so an injected 500 on a
-            # keep-alive connection doesn't leave unread body bytes to be
-            # misparsed as the client's next request
-            req = self._read_json()
-            frontend.engine.injector.fire("serving.http")
-            if self.path == "/adapt":
-                out = frontend.adapt(req["x_support"], req["y_support"], ctx=ctx)
-                self._send_json(200, out)
-            elif self.path == "/predict":
-                probs = frontend.predict(req["adaptation_id"], req["x_query"], ctx=ctx)
-                body = {"probs": probs.tolist()}
-                if ctx is not None:
-                    body["trace_id"] = ctx.trace_id
-                    body["timing"] = ctx.timing_ms(time.monotonic() - self._t0)
-                self._send_json(200, body)
-            elif self.path == "/adapt_predict":
-                out = frontend.adapt_predict(
-                    req["x_support"], req["y_support"], req["x_query"], ctx=ctx
-                )
-                out["probs"] = out["probs"].tolist()
-                self._send_json(200, out)
-            else:
-                self._log_http(frontend, "not_found", 404)
-                self._send_json(404, {"error": f"unknown path {self.path}"})
+            # the whole request — body parse through RESPONSE WRITE — sits
+            # inside the drain gate: a graceful drain cannot declare this
+            # request complete until its bytes are on the wire
+            with frontend.http_scope():
+                # fault seam for handler-level drills (raise -> 500, delay)
+                # — fired AFTER the body is drained so an injected 500 on a
+                # keep-alive connection doesn't leave unread body bytes to
+                # be misparsed as the client's next request
+                req = self._read_json()
+                frontend.engine.injector.fire("serving.http")
+                if self.path == "/adapt":
+                    out = frontend.adapt(req["x_support"], req["y_support"], ctx=ctx)
+                    self._send_json(200, out)
+                elif self.path == "/predict":
+                    probs = frontend.predict(req["adaptation_id"], req["x_query"], ctx=ctx)
+                    body = {"probs": probs.tolist()}
+                    if ctx is not None:
+                        body["trace_id"] = ctx.trace_id
+                        body["timing"] = ctx.timing_ms(time.monotonic() - self._t0)
+                    self._send_json(200, body)
+                elif self.path == "/adapt_predict":
+                    out = frontend.adapt_predict(
+                        req["x_support"], req["y_support"], req["x_query"], ctx=ctx
+                    )
+                    out["probs"] = out["probs"].tolist()
+                    self._send_json(200, out)
+                else:
+                    self._log_http(frontend, "not_found", 404)
+                    self._send_json(404, {"error": f"unknown path {self.path}"})
         except ServiceUnavailableError as exc:
             # load shed / breaker open (503) or router admission (429):
             # tell the client when to come back
@@ -868,3 +1128,67 @@ def serve_forever(frontend: ServingFrontend, host: str, port: int) -> None:
     finally:
         server.server_close()
         frontend.close()
+
+
+def drain_exit_code(info: Dict[str, Any]) -> int:
+    """The process rc for one drain verdict: 0 for a clean drain,
+    ``exit_codes.DRAIN_DEADLINE`` when in-flight work outlived the deadline
+    (the supervisor must treat the replica's last seconds as lossy)."""
+    return DRAIN_DEADLINE if info.get("deadline_exceeded") else OK
+
+
+def run_server(
+    frontend: ServingFrontend,
+    host: str,
+    port: int,
+    install_signal_handlers: bool = True,
+    on_bound=None,
+) -> int:
+    """Serve until SIGTERM/SIGINT, then drain gracefully and return the
+    process rc (``drain_exit_code``): the signal flips /healthz to
+    ``draining`` (the gateway stops routing new work), in-flight + queued
+    requests complete under ``serving.drain_deadline_s``, hot sessions
+    spill to the run dir, logs close, and a clean drain exits 0.
+    ``on_bound(host, port)`` fires after bind — the ephemeral-port
+    discovery hook for drills and supervisors."""
+    server = make_http_server(frontend, host, port)
+    addr = server.server_address
+    rc_box = {"rc": OK}
+
+    def _drain_and_stop(reason: str) -> None:
+        info = frontend.begin_drain(reason=reason)
+        rc_box["rc"] = drain_exit_code(info)
+        print(
+            f"serving drain: {'clean' if info.get('ok') else 'DEADLINE EXCEEDED'} "
+            f"in {info.get('seconds')}s "
+            f"({info.get('spilled_sessions', 0)} session(s) spilled)",
+            flush=True,
+        )
+        server.shutdown()
+
+    def _on_signal(signum, frame):  # noqa: ARG001 — signal contract
+        name = signal.Signals(signum).name.lower()
+        # the handler must return immediately; the drain runs on its own
+        # thread while serve_forever keeps answering in-flight work
+        threading.Thread(
+            target=_drain_and_stop, args=(name,), name="serving-drain", daemon=True
+        ).start()
+
+    if install_signal_handlers:
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+    print(
+        f"serving on http://{addr[0]}:{addr[1]} "
+        f"(checkpoint {frontend.engine.fingerprint[:12]}, "
+        f"platform {jax.default_backend()}, "
+        f"{len(frontend.pool)} replica(s))",
+        flush=True,
+    )
+    if on_bound is not None:
+        on_bound(addr[0], addr[1])
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+        frontend.close()
+    return rc_box["rc"]

@@ -1,0 +1,182 @@
+"""Session spill / rehydrate: adapted fast weights survive a graceful drain.
+
+A SIGTERM'd serving process used to take every cached adapted session with
+it — after a rolling restart each client's next predict was an honest 404
+and a full re-adapt. The drain path (``serving/server.py::begin_drain``) now
+spills hot sessions here, content-addressed under
+``<run>/saved_models/sessions/``, and a freshly started replica of the same
+run dir rehydrates them into its adapted-weight caches — a restart costs
+cache warmth bookkeeping, never correctness:
+
+- every file is **digest-wrapped** (format-2 checkpoint convention: the
+  body's sha256 rides inside the file) and written via the checkpoint
+  module's atomic temp+rename, so a kill mid-spill leaves an invisible temp
+  or a verifiable file, never a loadable-but-torn session;
+- a file that fails its digest is quarantined to ``*.corrupt`` (the
+  checkpoint convention) and NEVER served;
+- a session is only rehydrated for the SAME checkpoint fingerprint, and
+  only while its original cache TTL has not lapsed (spill records the
+  entry's age; wall-clock carries it across the restart) — stale or foreign
+  entries are ignored, so the fallback is always the existing honest 404 +
+  re-adapt, never a wrong answer.
+
+Consumed files are removed on load (the session is live again; the next
+drain re-spills it), so the directory holds exactly the sessions parked
+between two process lifetimes.
+"""
+
+import hashlib
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+from ..experiment.checkpoint import _write_atomic
+
+#: session spill format version (bumped on any layout change; a reader
+#: refuses versions it does not know rather than guessing)
+SESSION_FORMAT = 1
+
+_PREFIX = "session_"
+_SUFFIX = ".msgpack"
+
+
+class SessionStore:
+    """Content-addressed spill directory for adapted-weight cache entries."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _path(self, digest: str) -> str:
+        return os.path.join(self.root, f"{_PREFIX}{digest}{_SUFFIX}")
+
+    # -- spill ----------------------------------------------------------
+
+    def spill(
+        self,
+        digest: str,
+        tree: Any,
+        fingerprint: str,
+        age_s: float,
+        ttl_s: float,
+        wall_clock: Callable[[], float] = time.time,
+    ) -> str:
+        """Write one session (its adapted-parameter pytree) atomically,
+        digest-wrapped. ``age_s`` is how long the entry had already lived in
+        the cache; with ``ttl_s`` it lets the rehydrating process honor the
+        ORIGINAL expiry across the restart."""
+        os.makedirs(self.root, exist_ok=True)
+        body = serialization.msgpack_serialize(
+            {
+                "digest": str(digest),
+                "fingerprint": str(fingerprint),
+                "saved_at": float(wall_clock()),
+                "age_s": float(age_s),
+                "ttl_s": float(ttl_s),
+                "tree": serialization.to_bytes(jax.tree.map(np.asarray, tree)),
+            }
+        )
+        blob = serialization.msgpack_serialize(
+            {
+                "format": SESSION_FORMAT,
+                "sha256": hashlib.sha256(body).hexdigest(),
+                "body": body,
+            }
+        )
+        path = self._path(digest)
+        _write_atomic(path, blob)
+        return path
+
+    # -- rehydrate -------------------------------------------------------
+
+    def load_all(
+        self,
+        fingerprint: str,
+        template: Any,
+        wall_clock: Callable[[], float] = time.time,
+    ) -> Tuple[List[Tuple[str, Any, float]], Dict[str, int]]:
+        """-> (``[(digest, tree, lived_s)]`` safe to serve, stats).
+        Digest-verified; corrupt => quarantined ``*.corrupt``; TTL-lapsed
+        => removed and counted ``stale``; other-checkpoint entries counted
+        ``foreign`` and left for a replica of that checkpoint. ``lived_s``
+        is how much TTL budget the session has already consumed (cache age
+        before spill + wall time parked on disk) — the rehydrating cache
+        back-dates the entry with it, so a restart never extends a
+        session's original expiry. Loaded files are consumed (removed) —
+        they are live cache entries again."""
+        stats = {"loaded": 0, "stale": 0, "corrupt": 0, "foreign": 0}
+        entries: List[Tuple[str, Any, float]] = []
+        if not os.path.isdir(self.root):
+            return entries, stats
+        for name in sorted(os.listdir(self.root)):
+            if not (name.startswith(_PREFIX) and name.endswith(_SUFFIX)):
+                continue
+            path = os.path.join(self.root, name)
+            payload = self._read_verified(path)
+            if payload is None:
+                # torn/corrupt/unknown-format: quarantine like a corrupt
+                # checkpoint — visible for forensics, invisible to serving
+                os.replace(path, path + ".corrupt")
+                stats["corrupt"] += 1
+                continue
+            if payload["fingerprint"] != fingerprint:
+                stats["foreign"] += 1
+                continue
+            ttl_s = float(payload["ttl_s"])
+            lived_s = float(payload["age_s"]) + max(
+                0.0, wall_clock() - float(payload["saved_at"])
+            )
+            if ttl_s > 0 and lived_s > ttl_s:
+                os.remove(path)
+                stats["stale"] += 1
+                continue
+            try:
+                tree = serialization.from_bytes(template, payload["tree"])
+            except Exception:  # noqa: BLE001 — a structure mismatch is corrupt
+                os.replace(path, path + ".corrupt")
+                stats["corrupt"] += 1
+                continue
+            entries.append((payload["digest"], tree, lived_s))
+            stats["loaded"] += 1
+            os.remove(path)
+        return entries, stats
+
+    @staticmethod
+    def _read_verified(path: str) -> Optional[Dict[str, Any]]:
+        """Digest-verify + decode one spill file; None on ANY defect."""
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+            outer = serialization.msgpack_restore(blob)
+            if (
+                not isinstance(outer, dict)
+                or outer.get("format") != SESSION_FORMAT
+                or "body" not in outer
+                or "sha256" not in outer
+            ):
+                return None
+            body = outer["body"]
+            if hashlib.sha256(body).hexdigest() != outer["sha256"]:
+                return None
+            payload = serialization.msgpack_restore(body)
+            if not isinstance(payload, dict) or not all(
+                k in payload
+                for k in ("digest", "fingerprint", "saved_at", "age_s", "ttl_s", "tree")
+            ):
+                return None
+            return payload
+        except Exception:  # noqa: BLE001 — any decode failure is corruption
+            return None
+
+    def pending(self) -> int:
+        """Spilled sessions currently parked on disk (drill assertions)."""
+        if not os.path.isdir(self.root):
+            return 0
+        return sum(
+            1
+            for name in os.listdir(self.root)
+            if name.startswith(_PREFIX) and name.endswith(_SUFFIX)
+        )

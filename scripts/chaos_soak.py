@@ -2,13 +2,13 @@
 """Seeded chaos-soak campaign over the resilience subsystem.
 
 Usage:
-    python scripts/chaos_soak.py --episodes 12 --seed 0 [--work-dir DIR]
+    python scripts/chaos_soak.py --episodes 15 --seed 0 [--work-dir DIR]
         [--no-subprocess]
 
 Samples fault injections across every registered seam (checkpoint
 read/write, loader episode assembly, runner step dispatch, serving dispatch,
 HTTP handler — see ``resilience/faults.py``), runs a short train / resume /
-shrink / serve episode under each, and checks the cross-cutting invariants
+shrink / serve / cross-process gateway episode under each, and checks the cross-cutting invariants
 after every one (documented rc, loadable latest-or-fallback checkpoint,
 well-formed events.jsonl, serving never 200s a failure). Deterministic in
 ``--seed``.
@@ -59,7 +59,7 @@ setup_compilation_cache(test_tuning=True)
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("--episodes", type=int, default=12)
+    parser.add_argument("--episodes", type=int, default=15)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--work-dir",

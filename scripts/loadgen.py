@@ -59,6 +59,14 @@ def main(argv=None) -> int:
     parser.add_argument("--max-shed-rate", type=float, default=0.05)
     parser.add_argument("--run-dir", default=None,
                         help="serve this experiment's checkpoint instead of synthetic weights")
+    parser.add_argument(
+        "--url", default=None,
+        help="drive an ALREADY-RUNNING gateway or serving frontend at this "
+        "base URL (external-process target; scripts/gateway.py) instead of "
+        "building an in-process engine — the report gains per-backend "
+        "outcome counts from X-Gateway-Backend. BENCH_GATEWAY env is the "
+        "same knob for bench_serving.py.",
+    )
     parser.add_argument("--n-way", type=int, default=5)
     parser.add_argument("--k-shot", type=int, default=1)
     parser.add_argument("--full", action="store_true",
@@ -87,6 +95,10 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
     stairs = _parse_stairs(args.stairs)
+    if args.url and args.run_dir:
+        # an external-process target serves ITS OWN checkpoint; a local
+        # run dir cannot also be the backend — refuse instead of guessing
+        raise SystemExit("loadgen: --url and --run-dir are mutually exclusive")
 
     from howtotrainyourmamlpytorch_tpu.observability import slo
 
@@ -137,7 +149,14 @@ def main(argv=None) -> int:
 
     log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
 
-    if args.run_dir:
+    if args.url:
+        # external-process target: the gateway (or a lone frontend) is
+        # already running — same open-loop schedule, driven over the wire
+        frontend = slo.HttpFrontend(args.url)
+        n_way, k_shot = args.n_way, args.k_shot
+        cfg = None
+        model_label = f"url:{args.url}"
+    elif args.run_dir:
         from howtotrainyourmamlpytorch_tpu.serving.server import frontend_from_run_dir
 
         # from_run_dir already points access.jsonl at the run's own logs/
@@ -170,6 +189,7 @@ def main(argv=None) -> int:
         )
         model_label = f"vgg{stages}x{filters}"
     img_shape = cfg.image_shape if args.run_dir else (28, 28, 1)
+    n_replicas = len(frontend.pool) if getattr(frontend, "pool", None) else None
 
     max_query = max(max(query_sizes), max(r.n_query for r in schedule))
     targets_per_class = max(max_query // n_way + 1, 1)
@@ -189,7 +209,8 @@ def main(argv=None) -> int:
     log(
         f"loadgen: seed={args.seed} duration={args.duration_s}s "
         f"stairs={stairs} req/s, {len(schedule)} requests, model "
-        f"{model_label}, {len(frontend.pool)} replica(s)"
+        f"{model_label}"
+        + (f", {n_replicas} replica(s)" if n_replicas is not None else "")
     )
     run = slo.run_load(
         frontend,
@@ -217,8 +238,15 @@ def main(argv=None) -> int:
         ),
         model=model_label,
         adapt_frac=args.adapt_frac,
-        replicas=len(frontend.pool),
+        replicas=n_replicas,
         schedule_digest=slo.schedule_digest(schedule),
+        # external-process target: the gateway's per-backend outcome story
+        # (X-Gateway-Backend tallies) — the multi-host twin of per_replica
+        **(
+            {"target": args.url, "per_backend": frontend.per_backend()}
+            if args.url
+            else {}
+        ),
     )
     if frontend.access_log is not None and frontend.hub.enabled:
         # the flow-linked span trace lands NEXT TO access.jsonl, so a worst

@@ -343,10 +343,33 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
             for k, v in counts.items()
             if k in ("nan_step_skipped", "nan_rollback", "nan_abort",
                      "preempted", "wedged", "wedge_checkpoint",
-                     "degraded_mesh", "early_abort", "donation_refused")
+                     "degraded_mesh", "early_abort", "donation_refused",
+                     "replica_death", "backend_out", "backend_in",
+                     "drain_begin", "drain_complete",
+                     "sessions_spilled", "sessions_rehydrated")
         }
         if notable:
             report["notable_events"] = notable
+        # serving/fleet lifecycle timeline (ISSUE 14): replica deaths,
+        # gateway membership flaps, drain milestones, session
+        # spill/rehydrate — chronological, so "when did r1 die and who
+        # absorbed it" is answerable from the run dir after the fact
+        serving_events = [
+            {
+                k: rec.get(k)
+                for k in ("ts", "event", "replica", "backend", "reason",
+                          "status", "routable", "count", "deadline_exceeded",
+                          "spilled_sessions", "loaded", "stale", "corrupt",
+                          "in_count")
+                if rec.get(k) is not None
+            }
+            for rec in event_records
+            if rec.get("event")
+            in ("replica_death", "backend_out", "backend_in", "drain_begin",
+                "drain_complete", "sessions_spilled", "sessions_rehydrated")
+        ]
+        if serving_events:
+            report["serving_events"] = serving_events
         # donation bookkeeping (ISSUE 12): the audit table (donatable vs
         # donated bytes per planned program) and, when the aliasing
         # self-check refused donation, its verdict
@@ -695,6 +718,16 @@ def render_human(report: Dict[str, Any]) -> str:
                 "  notable: "
                 + "  ".join(f"{k}={v}" for k, v in sorted(report["notable_events"].items()))
             )
+    if report.get("serving_events"):
+        lines.append("-- serving/fleet lifecycle (chronological) --")
+        for rec in report["serving_events"]:
+            ts = rec.get("ts")
+            stamp = f"{ts:.3f}" if isinstance(ts, (int, float)) else "-"
+            detail = "  ".join(
+                f"{k}={v}" for k, v in sorted(rec.items())
+                if k not in ("ts", "event")
+            )
+            lines.append(f"  {stamp}  {rec.get('event'):<20} {detail}")
     dev = report.get("device_breakdown")
     if dev and "error" not in dev:
         lines.append("-- device time (xplane) --")

@@ -131,6 +131,8 @@ def serving_frame(
         "breaker_opens": breaker.get("opens", 0),
         "cache_hit_rate": (metrics.get("cache") or {}).get("hit_rate"),
         "prewarm": (metrics.get("prewarm") or {}).get("status"),
+        "draining": (metrics.get("drain") or {}).get("draining"),
+        "sessions_rehydrated": (metrics.get("sessions") or {}).get("rehydrated"),
         "access_log_lines": (metrics.get("access_log") or {}).get("lines"),
         "hbm_headroom_frac": _min_headroom(metrics.get("memory")),
         "padding_waste_frac": (metrics.get("padding") or {}).get(
@@ -160,6 +162,45 @@ def serving_frame(
             if isinstance(r, dict)
         ]
     return frame
+
+
+def gateway_frame(
+    metrics: Dict[str, Any], prev: Optional[Dict[str, Any]], interval_s: float
+) -> Dict[str, Any]:
+    """One console frame from a GATEWAY /metrics payload (scripts/gateway.py):
+    proxied qps + the per-backend membership table — which hosts are IN,
+    OUT, warming, or draining, and who is eating the traffic."""
+    completed = int(metrics.get("requests", 0))
+    qps = None
+    if prev is not None and prev.get("_completed") is not None and interval_s > 0:
+        qps = round(max(0, completed - prev["_completed"]) / interval_s, 2)
+    return {
+        "source": "gateway",
+        "uptime_s": metrics.get("uptime_s"),
+        "qps": qps,
+        "requests": completed,
+        "backends_in": metrics.get("backends_in"),
+        "backends_total": len(metrics.get("backends") or []),
+        "retries": metrics.get("retries"),
+        "admission_shed": metrics.get("admission_shed"),
+        "no_backend": metrics.get("no_backend"),
+        "sessions": metrics.get("sessions"),
+        "backends": [
+            {
+                "backend": b.get("backend"),
+                "url": b.get("url"),
+                "state": b.get("state"),
+                "last_status": b.get("last_status"),
+                "flaps": b.get("flaps"),
+                "routed": b.get("routed"),
+                "retried_away": b.get("retried_away"),
+            }
+            for b in metrics.get("backends") or []
+            if isinstance(b, dict)
+        ],
+        "access_log_lines": (metrics.get("access_log") or {}).get("lines"),
+        "_completed": completed,
+    }
 
 
 def _min_headroom(memory: Optional[Dict[str, Any]]) -> Optional[float]:
@@ -221,10 +262,34 @@ def render(frame: Dict[str, Any]) -> str:
     lines: List[str] = []
     if frame.get("error"):
         return f"obs_top: {frame['error']}"
+    if frame["source"] == "gateway":
+        lines.append(
+            f"gateway  up {_fmt(frame['uptime_s'])}s   qps {_fmt(frame['qps'])}   "
+            f"requests {_fmt(frame['requests'])}   "
+            f"in {_fmt(frame['backends_in'])}/{_fmt(frame['backends_total'])}"
+        )
+        lines.append(
+            f"route    retries {_fmt(frame['retries'])}   "
+            f"429 {_fmt(frame['admission_shed'])}   "
+            f"no_backend {_fmt(frame['no_backend'])}   "
+            f"sessions {_fmt(frame['sessions'])}   "
+            f"access_log {_fmt(frame['access_log_lines'])} lines"
+        )
+        for b in frame.get("backends") or []:
+            state = (b.get("state") or "?").upper()
+            lines.append(
+                f"  {b.get('backend'):<4} {state:<4} "
+                f"status {_fmt(b.get('last_status')):<12} "
+                f"routed {_fmt(b.get('routed'))}  "
+                f"retried_away {_fmt(b.get('retried_away'))}  "
+                f"flaps {_fmt(b.get('flaps'))}  {b.get('url')}"
+            )
+        return "\n".join(lines)
     if frame["source"] == "serving":
         lines.append(
             f"serving  up {_fmt(frame['uptime_s'])}s   qps {_fmt(frame['qps'])}   "
             f"requests {_fmt(frame['requests'])}   prewarm {_fmt(frame['prewarm'])}"
+            + ("   DRAINING" if frame.get("draining") else "")
         )
         lines.append(
             f"queue    adapt {_fmt(frame['queue_depth']['adapt'])}  "
@@ -289,6 +354,9 @@ def build_frame(
             metrics = _fetch_metrics(args.url, args.timeout_s)
         except (urllib.error.URLError, OSError, json.JSONDecodeError) as exc:
             return {"source": "serving", "error": f"{args.url} unreachable: {exc}"}
+        if metrics.get("gateway"):
+            # a gateway's /metrics: membership per backend, not one engine
+            return gateway_frame(metrics, prev, args.interval)
         return serving_frame(metrics, prev, args.interval)
     path = os.path.join(args.run_dir, "logs", "telemetry.jsonl")
     snapshot = _tail_jsonl_last(path)

@@ -38,7 +38,7 @@ if os.environ.get("JAX_PLATFORMS"):
 from howtotrainyourmamlpytorch_tpu.config import load_config  # noqa: E402
 from howtotrainyourmamlpytorch_tpu.serving import (  # noqa: E402
     ServingFrontend,
-    serve_forever,
+    run_server,
 )
 from howtotrainyourmamlpytorch_tpu.serving.engine import AdaptationEngine  # noqa: E402
 
@@ -93,13 +93,17 @@ def main(argv=None) -> int:
     serving = frontend.engine.serving
     host = args.host if args.host is not None else serving.host
     port = args.port if args.port is not None else serving.port
+    # SIGTERM/SIGINT -> graceful drain: /healthz flips to "draining" (a
+    # gateway stops routing new work), in-flight + queued requests complete
+    # under serving.drain_deadline_s, hot adapted sessions spill to the run
+    # dir (rehydrated on the next start), logs close. Clean drain exits 0;
+    # deadline expiry exits exit_codes.DRAIN_DEADLINE — see
+    # docs/OPERATIONS.md "Multi-host serving".
     try:
-        serve_forever(frontend, host, port)
-    except KeyboardInterrupt:
-        pass
+        rc = run_server(frontend, host, port)
     finally:
         frontend.close()
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

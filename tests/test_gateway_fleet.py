@@ -1,0 +1,676 @@
+"""Multi-host serving fleet (ISSUE 14): gateway membership + routing units,
+graceful-drain semantics, session spill/rehydrate, and THE cross-process
+chaos drills — a real ``scripts/gateway.py`` subprocess fronting real serve
+backends (``campaign.child_serve_main`` through the actual ``run_server``
+SIGTERM drain path): kill -9 (availability survives, displaced sessions
+re-adapt — never stale), SIGTERM drain (zero dropped in-flight requests +
+a digest-verified spill -> rehydrate cache hit after restart), and a full
+rolling restart under load with every non-200 resolvable to a gateway
+access line.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu import exit_codes
+from howtotrainyourmamlpytorch_tpu.config import Config, ServingConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+from howtotrainyourmamlpytorch_tpu.resilience.campaign import (
+    Episode,
+    _run_gateway_episode,
+    make_serving_run_dir,
+)
+from howtotrainyourmamlpytorch_tpu.resilience.faults import FaultInjector
+from howtotrainyourmamlpytorch_tpu.serving import (
+    AdaptationEngine,
+    Gateway,
+    ServiceUnavailableError,
+    ServingFrontend,
+    SessionStore,
+    UnknownAdaptationError,
+    drain_exit_code,
+    make_gateway_server,
+)
+from howtotrainyourmamlpytorch_tpu.serving import gateway as gateway_mod
+from howtotrainyourmamlpytorch_tpu.serving import router as router_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_IMG = (28, 28, 1)
+
+
+def test_rendezvous_has_one_implementation():
+    """The in-process router and the multi-host gateway must agree where a
+    session lives: the router's rendezvous_score IS the gateway's (single
+    definition, re-exported) — not a lookalike that could drift."""
+    assert router_mod.rendezvous_score is gateway_mod.rendezvous_score
+    # process-stable: a pinned value, not just self-consistency
+    assert gateway_mod.rendezvous_score("digest001", 0) == int.from_bytes(
+        __import__("hashlib").blake2b(b"digest001|0", digest_size=8).digest(), "big"
+    )
+
+
+# ---------------------------------------------------------------------------
+# membership hysteresis (pure units, no sockets)
+# ---------------------------------------------------------------------------
+
+
+def test_backend_membership_hysteresis_and_flaps():
+    b = gateway_mod.Backend(0, "http://x", fail_threshold=2, pass_threshold=2)
+    assert not b.is_in  # starts OUT: never seen healthy
+    assert b.note_observation(True, "ok") is None  # 1/2 passes
+    assert b.note_observation(True, "ok") == "in"
+    assert b.is_in and b.flaps == 0  # first admission is not a flap
+    # one failure is not enough to eject
+    assert b.note_observation(False, "unreachable") is None
+    assert b.is_in
+    # a pass resets the failure streak
+    assert b.note_observation(True, "ok") is None
+    assert b.note_observation(False, "unreachable") is None
+    assert b.note_observation(False, "unreachable") == "out"
+    assert not b.is_in and b.flaps == 1
+    # recovery: two consecutive passes readmit (and count a flap)
+    assert b.note_observation(True, "ok") is None
+    assert b.note_observation(True, "ok") == "in"
+    assert b.flaps == 2
+    snap = b.snapshot()
+    assert snap["state"] == "in" and snap["flaps"] == 2
+
+
+def test_gateway_routing_rendezvous_and_exclusion():
+    g = Gateway(["http://a", "http://b", "http://c"], pass_threshold=1)
+    for backend in g.backends:
+        g.observe(backend, True, "ok")
+    keys = [f"k{i:03d}" for i in range(120)]
+    owners = {k: g.route(k).index for k in keys}
+    assert set(owners.values()) == {0, 1, 2}
+    assert all(g.route(k).index == owners[k] for k in keys)  # deterministic
+    # exclusion remaps ONLY the excluded backend's keys
+    for k in keys:
+        alt = g.route(k, exclude={owners[k]})
+        assert alt is not None and alt.index != owners[k]
+    other = {k: g.route(k).index for k in keys if owners[k] != 0}
+    g.observe(g.backends[0], False, "unreachable")
+    g.observe(g.backends[0], False, "unreachable")
+    assert not g.backends[0].is_in
+    assert all(g.route(k).index == other[k] for k in other)  # no reshuffle
+    g.close()
+
+
+def test_gateway_draining_warming_are_not_routable_new_work():
+    """A reachable backend whose healthz body says warming/draining is
+    alive but must leave rotation (hysteresis applies) — the drain/rolling
+    restart membership contract."""
+    g = Gateway(["http://a", "http://b"], pass_threshold=1, fail_threshold=2)
+    for backend in g.backends:
+        g.observe(backend, True, "ok")
+    assert g.in_count() == 2
+    for _ in range(2):
+        g.observe(g.backends[0], False, "draining")
+    assert g.in_count() == 1
+    assert g.backends[0].snapshot()["last_status"] == "draining"
+    code, body = g.healthz()
+    assert code == 200 and body["status"] == "degraded"
+    for _ in range(2):
+        g.observe(g.backends[1], False, "warming")
+    code, body = g.healthz()
+    assert code == 503 and body["status"] == "no_backend"
+    g.close()
+
+
+# ---------------------------------------------------------------------------
+# proxy behavior over real sockets (fake jax-free backends)
+# ---------------------------------------------------------------------------
+
+
+class _FakeServe(BaseHTTPRequestHandler):
+    """Scriptable fake serve backend: behavior comes from server.script."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, code, body, headers=None):
+        raw = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def do_GET(self):  # noqa: N802
+        self._send(200, {"status": "ok"})
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(n)
+        script = self.server.script  # type: ignore[attr-defined]
+        code, body, headers = script(self.server.name, self.path)  # type: ignore[attr-defined]
+        if self.server.delay_s:  # type: ignore[attr-defined]
+            time.sleep(self.server.delay_s)  # type: ignore[attr-defined]
+        self._send(code, body, headers)
+
+
+def _spawn_fake(name, script, delay_s=0.0):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeServe)
+    srv.name = name
+    srv.script = script
+    srv.delay_s = delay_s
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _post(url, payload, headers=None, timeout=10):
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers.items())
+
+
+def test_gateway_retry_with_exclusion_session_learning_and_access_log(tmp_path):
+    """A 500 from the routed backend retries against the next-ranked live
+    backend (counted); the adapt response teaches the session table so the
+    session's predict follows its fast weights; every request logs ONE
+    gateway access line carrying the backend field; backend refusals (503
+    shed) pass through with Retry-After."""
+    import urllib.error
+    import urllib.request
+
+    calls = {"s0": 0, "s1": 0}
+
+    def script(name, path):
+        calls[name] += 1
+        if name == "s0":
+            return 500, {"error": "boom"}, None
+        if path == "/adapt":
+            return 200, {"adaptation_id": "aid-9", "cached": False}, None
+        return 200, {"probs": [[1.0]]}, None
+
+    s0, u0 = _spawn_fake("s0", script)
+    s1, u1 = _spawn_fake("s1", script)
+    g = Gateway([u0, u1], health_interval_s=30.0, pass_threshold=1,
+                log_dir=str(tmp_path))
+    for backend in g.backends:
+        g.observe(backend, True, "ok")
+    server = make_gateway_server(g, "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        # drive adapts until one rendezvous-routes to s0 first (500 -> retry)
+        saw_retry = False
+        for i in range(8):
+            code, body, headers = _post(
+                base + "/adapt", {"x_support": [i], "y_support": [i]}
+            )
+            assert code == 200
+            assert headers["X-Gateway-Backend"] == "b1"  # s0 always 500s
+            assert len(headers["X-Request-Id"]) == 32
+            if g.metrics()["retries"] > 0:
+                saw_retry = True
+                break
+        assert saw_retry, "no adapt ever routed to the failing backend first"
+        # session affinity: the predict for aid-9 goes to b1 (learned), and
+        # b1 answers without s0 seeing the request
+        s0_calls = calls["s0"]
+        code, body, headers = _post(
+            base + "/predict", {"adaptation_id": "aid-9", "x_query": [1]}
+        )
+        assert code == 200 and headers["X-Gateway-Backend"] == "b1"
+        assert calls["s0"] == s0_calls
+        # backend refusal passes through with Retry-After, NOT retried
+        s1.script = lambda name, path: (
+            503, {"error": "queue full — shedding"}, {"Retry-After": "7"}
+        )
+        s0.script = s1.script
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(base + "/predict", {"adaptation_id": "aid-9", "x_query": [1]})
+        assert err.value.code == 503
+        assert err.value.headers["Retry-After"] == "7"
+        # the gateway access log: one line per request, backend named
+        g.access.close()
+        with open(os.path.join(str(tmp_path), "access.jsonl")) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        assert all("backend" in r and "trace_id" in r for r in records)
+        ok_lines = [r for r in records if r["outcome"] == "ok"]
+        assert ok_lines and all(r["backend"] == "b1" for r in ok_lines)
+        shed_lines = [r for r in records if r["outcome"] == "shed"]
+        assert shed_lines and shed_lines[-1]["status"] == 503
+    finally:
+        server.shutdown()
+        server.server_close()
+        g.close()
+        for srv in (s0, s1):
+            srv.shutdown()
+            srv.server_close()
+
+
+def test_gateway_admission_control_sheds_429():
+    s0, u0 = _spawn_fake("s0", lambda n, p: (200, {"probs": [[1.0]]}, None),
+                         delay_s=0.6)
+    g = Gateway([u0], health_interval_s=30.0, pass_threshold=1, max_inflight=1)
+    g.observe(g.backends[0], True, "ok")
+    server = make_gateway_server(g, "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    outcomes = []
+    lock = threading.Lock()
+
+    def one():
+        import urllib.error
+
+        try:
+            code, _, headers = _post(
+                base + "/predict", {"adaptation_id": "a", "x_query": [1]},
+                timeout=30,
+            )
+            row = (code, None)
+        except urllib.error.HTTPError as exc:
+            row = (exc.code, exc.headers.get("Retry-After"))
+        with lock:
+            outcomes.append(row)
+
+    threads = [threading.Thread(target=one) for _ in range(3)]
+    threads[0].start()
+    time.sleep(0.15)
+    for t in threads[1:]:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    try:
+        codes = sorted(c for c, _ in outcomes)
+        assert 200 in codes and 429 in codes, outcomes
+        assert all(ra is not None for c, ra in outcomes if c == 429)
+        assert g.metrics()["admission_shed"] >= 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        g.close()
+        s0.shutdown()
+        s0.server_close()
+
+
+def test_gateway_and_rolling_restart_scripts_are_import_light():
+    """Both CLIs must run on a gateway-only host with NO jax installed:
+    loading them with jax imports banned must succeed (they file-path-load
+    exit_codes / serving/gateway.py instead of importing the package)."""
+    probe = (
+        "import builtins, runpy, sys\n"
+        "real = builtins.__import__\n"
+        "def guard(name, *a, **k):\n"
+        "    if name == 'jax' or name.startswith('jax.') or "
+        "name.startswith('howtotrainyourmamlpytorch_tpu'):\n"
+        "        raise ImportError('banned on a gateway-only host: ' + name)\n"
+        "    return real(name, *a, **k)\n"
+        "builtins.__import__ = guard\n"
+        "runpy.run_path(sys.argv[1], run_name='not_main')\n"
+        "print('LOADED', sys.argv[1])\n"
+    )
+    for script in ("gateway.py", "rolling_restart.py"):
+        proc = subprocess.run(
+            [sys.executable, "-c", probe, os.path.join("scripts", script)],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, (script, proc.stderr)
+        assert "LOADED" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# HttpFrontend (loadgen --url / BENCH_GATEWAY): wire -> outcome taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_http_frontend_outcome_mapping_and_per_backend_counts():
+    from howtotrainyourmamlpytorch_tpu.observability.slo import HttpFrontend
+
+    state = {"mode": "ok"}
+
+    def script(name, path):
+        if state["mode"] == "shed":
+            return 503, {"error": "shed"}, {"Retry-After": "3"}
+        if state["mode"] == "unknown":
+            return 404, {"error": "unknown id"}, None
+        if path == "/adapt":
+            return 200, {"adaptation_id": "aid-1"}, None
+        return 200, {"probs": [[0.25, 0.75]]}, None
+
+    srv, url = _spawn_fake("s0", script)
+    # fake gateway header so per-backend tallies have a name
+    orig = srv.script
+
+    def with_header(name, path):
+        code, body, headers = orig(name, path)
+        return code, body, {**(headers or {}), "X-Gateway-Backend": "b0"}
+
+    srv.script = with_header
+    frontend = HttpFrontend(url, timeout_s=10)
+    try:
+        info = frontend.adapt(np.zeros((2, 2)), np.zeros(2, np.int32))
+        assert info["adaptation_id"] == "aid-1"
+        probs = frontend.predict("aid-1", np.zeros((1, 2)))
+        assert probs.shape == (1, 2)
+        state["mode"] = "shed"
+        with pytest.raises(ServiceUnavailableError) as err:
+            frontend.predict("aid-1", np.zeros((1, 2)))
+        assert err.value.status == 503 and err.value.retry_after_s == 3.0
+        state["mode"] = "unknown"
+        with pytest.raises(UnknownAdaptationError):
+            frontend.predict("aid-1", np.zeros((1, 2)))
+        counts = frontend.per_backend()["b0"]
+        assert counts["ok"] == 2 and counts["shed"] == 1 and counts["unknown_id"] == 1
+        assert frontend.breaker.snapshot() == {}  # run_load contract
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# drain semantics + healthz status schema (tiny real engine)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def drain_setup():
+    cfg = Config(
+        num_classes_per_set=5,
+        num_samples_per_class=2,
+        num_target_samples=3,
+        batch_size=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        serving=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=1
+        ),
+    )
+    system = MAMLSystem(
+        cfg, model=build_vgg(_IMG, 5, num_stages=2, cnn_num_filters=4)
+    )
+    engine = AdaptationEngine(system, system.init_train_state())
+    # settle the compiles outside every timed drain window
+    b = synthetic_batch(1, 5, 2, 3, _IMG, seed=1)
+    fw = engine.adapt(b["x_support"][0], b["y_support"][0])
+    engine.predict(fw, b["x_target"][0].reshape((-1,) + _IMG))
+    yield cfg, engine
+
+
+def _episode(seed):
+    b = synthetic_batch(1, 5, 2, 3, _IMG, seed=seed)
+    return (
+        b["x_support"][0],
+        b["y_support"][0],
+        b["x_target"][0].reshape((-1,) + _IMG),
+    )
+
+
+def test_drain_completes_inflight_and_queued_then_refuses(drain_setup):
+    """SIGTERM semantics at the unit level: requests in flight (and queued
+    behind them) when the drain begins ALL complete; a request arriving
+    after drain starts is refused 503 + Retry-After; healthz flips to
+    'draining' (503 class) for the gateway to see."""
+    cfg, engine = drain_setup
+    inj = FaultInjector.from_specs(
+        ["serving.dispatch=delay:delay_s=0.25,p=1.0"], include_env=False
+    )
+    old = engine.injector
+    engine.injector = inj
+    frontend = ServingFrontend(engine)
+    try:
+        x_s, y_s, x_q = _episode(5)
+        info = frontend.adapt(x_s, y_s)
+        results = []
+        lock = threading.Lock()
+
+        def one():
+            try:
+                p = frontend.predict(info["adaptation_id"], x_q)
+                row = ("ok", np.asarray(p))
+            except Exception as exc:  # noqa: BLE001 — the row is the verdict
+                row = (type(exc).__name__, None)
+            with lock:
+                results.append(row)
+
+        threads = [threading.Thread(target=one) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # in flight: first mid-dispatch, rest queued
+        drain_box = {}
+
+        def drain():
+            drain_box.update(frontend.begin_drain(reason="unit"))
+
+        drainer = threading.Thread(target=drain)
+        drainer.start()
+        time.sleep(0.05)
+        assert frontend.healthz()["status"] == "draining"
+        # a NEW request during the drain: 503 + Retry-After, never queued
+        with pytest.raises(ServiceUnavailableError) as err:
+            frontend.predict(info["adaptation_id"], x_q)
+        assert err.value.status == 503 and err.value.retry_after_s > 0
+        for t in threads:
+            t.join(timeout=60)
+        drainer.join(timeout=60)
+        assert [r[0] for r in results] == ["ok", "ok", "ok"], results
+        assert drain_box["ok"] is True and drain_box["deadline_exceeded"] is False
+        assert drain_exit_code(drain_box) == exit_codes.OK
+    finally:
+        engine.injector = old
+        frontend.close()
+
+
+def test_drain_deadline_expiry_takes_the_registered_rc(drain_setup):
+    """A drain that cannot finish inside the deadline reports
+    deadline_exceeded and maps to exit_codes.DRAIN_DEADLINE — a distinct,
+    registered rc (not 0, not the wedge 76)."""
+    cfg, engine = drain_setup
+    inj = FaultInjector.from_specs(
+        ["serving.dispatch=delay:delay_s=1.2,p=1.0"], include_env=False
+    )
+    old = engine.injector
+    engine.injector = inj
+    frontend = ServingFrontend(engine)
+    try:
+        x_s, y_s, x_q = _episode(6)
+        info_box = {}
+
+        def adapt_slow():
+            try:
+                info_box["info"] = frontend.adapt(x_s, y_s)
+            except Exception as exc:  # noqa: BLE001
+                info_box["error"] = exc
+
+        t = threading.Thread(target=adapt_slow)
+        t.start()
+        time.sleep(0.2)
+        info = frontend.begin_drain(deadline_s=0.2, reason="unit")
+        assert info["deadline_exceeded"] is True and info["ok"] is False
+        rc = drain_exit_code(info)
+        assert rc == exit_codes.DRAIN_DEADLINE == 77
+        assert rc not in (exit_codes.OK, exit_codes.WEDGED, exit_codes.PREEMPTED)
+        t.join(timeout=60)
+    finally:
+        engine.injector = old
+        frontend.close()
+
+
+def test_healthz_status_schema_pinned(drain_setup):
+    """Satellite fix: drain / warm / degraded are DISTINCT machine-readable
+    status values (one field, four values) — a gateway switches on
+    healthz["status"] alone, so the schema is pinned here."""
+    cfg, engine = drain_setup
+    frontend = ServingFrontend(engine, replicas=2)
+    observed = set()
+    try:
+        observed.add(frontend.healthz()["status"])
+        # degraded: a dead replica (fleet partially down, still routable)
+        frontend.kill_replica(0, reason="schema-pin")
+        health = frontend.healthz()
+        assert health["status"] == "degraded" and health["routable"] == 1
+        observed.add(health["status"])
+        # warming: the AOT prewarm still compiling
+        with frontend._prewarm_lock:
+            saved = frontend._prewarm
+            frontend._prewarm = {"status": "warming"}
+        observed.add(frontend.healthz()["status"])
+        with frontend._prewarm_lock:
+            frontend._prewarm = saved
+        # draining beats everything: the replica is leaving
+        frontend.begin_drain(reason="schema-pin")
+        observed.add(frontend.healthz()["status"])
+        # THE pin: one field, exactly these four machine-readable values —
+        # each reachable, none conflated with another
+        assert observed == {"ok", "degraded", "warming", "draining"}
+    finally:
+        frontend.close()
+
+
+def test_session_store_verdicts_corrupt_stale_foreign(tmp_path, drain_setup):
+    """Rehydration safety matrix: digest-verified load; corrupt file ->
+    quarantined *.corrupt, never served; TTL-lapsed -> ignored+removed;
+    other-checkpoint fingerprint -> left untouched; loaded -> consumed."""
+    cfg, engine = drain_setup
+    store = SessionStore(str(tmp_path / "sessions"))
+    x_s, y_s, _ = _episode(7)
+    tree = engine.adapt(x_s, y_s)
+    store.spill("d" * 64, tree, "fp-A", age_s=0.0, ttl_s=600.0)
+    store.spill("e" * 64, tree, "fp-A", age_s=599.0, ttl_s=600.0,
+                wall_clock=lambda: time.time() - 10.0)  # already lapsed
+    store.spill("f" * 64, tree, "fp-B", age_s=0.0, ttl_s=600.0)
+    corrupt_path = store.spill("a" * 64, tree, "fp-A", age_s=0.0, ttl_s=600.0)
+    with open(corrupt_path, "r+b") as f:
+        f.seek(100)
+        f.write(b"\x00" * 32)
+    assert store.pending() == 4
+    entries, stats = store.load_all("fp-A", template=engine.state.params)
+    assert stats == {"loaded": 1, "stale": 1, "corrupt": 1, "foreign": 1}
+    assert [d for d, _, _ in entries] == ["d" * 64]
+    # lived_s reports the TTL budget already consumed (cache age at spill +
+    # wall time on disk) — what the rehydrating cache back-dates with
+    assert entries[0][2] >= 0.0
+    # the loaded tree round-trips bit-identically
+    np.testing.assert_array_equal(
+        np.asarray(next(iter(jax_leaves(tree)))),
+        np.asarray(next(iter(jax_leaves(entries[0][1])))),
+    )
+    # corrupt quarantined (visible), foreign left, loaded+stale gone
+    names = sorted(os.listdir(store.root))
+    assert any(n.endswith(".corrupt") for n in names)
+    assert any(("f" * 64) in n for n in names)
+    assert store.pending() == 1  # only the foreign one still parked
+
+
+def jax_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+# ---------------------------------------------------------------------------
+# obs_top: gateway frame
+# ---------------------------------------------------------------------------
+
+
+def _load_obs_top():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_top_gwtest", os.path.join(REPO, "scripts", "obs_top.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_obs_top_renders_gateway_membership_per_backend():
+    obs_top = _load_obs_top()
+    metrics = {
+        "gateway": True,
+        "requests": 40,
+        "retries": 3,
+        "admission_shed": 1,
+        "no_backend": 0,
+        "sessions": 5,
+        "backends_in": 1,
+        "uptime_s": 12.5,
+        "access_log": {"lines": 40},
+        "backends": [
+            {"backend": "b0", "url": "http://h0:8100", "state": "in",
+             "last_status": "ok", "flaps": 0, "routed": 30, "retried_away": 0},
+            {"backend": "b1", "url": "http://h1:8100", "state": "out",
+             "last_status": "draining", "flaps": 1, "routed": 10,
+             "retried_away": 3},
+        ],
+    }
+    prev = obs_top.gateway_frame(metrics, None, 2.0)
+    assert prev["source"] == "gateway" and prev["qps"] is None
+    frame = obs_top.gateway_frame({**metrics, "requests": 50}, prev, 2.0)
+    assert frame["qps"] == 5.0
+    assert frame["backends_in"] == 1 and frame["backends_total"] == 2
+    rendered = obs_top.render(frame)
+    assert "b0" in rendered and "IN" in rendered
+    assert "b1" in rendered and "OUT" in rendered and "draining" in rendered
+
+
+# ---------------------------------------------------------------------------
+# THE cross-process drills (subprocess gateway + real serve backends)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_template(tmp_path_factory):
+    """One toy serving run dir (config + init-state checkpoint) shared by
+    every cross-process drill — each drill copies it byte-for-byte, so the
+    whole module pays for ONE checkpoint build."""
+    root = tmp_path_factory.mktemp("fleet_template")
+    return make_serving_run_dir(str(root), "template")
+
+
+def _run_drill(kind, tmp_path, fleet_template):
+    violations = _run_gateway_episode(
+        Episode(kind=kind, mode="gateway", subprocess=True),
+        work_dir=str(tmp_path),
+        template_run=fleet_template,
+    )
+    assert violations == [], violations
+
+
+def test_cross_process_kill9_availability_and_honest_failover(
+    tmp_path, fleet_template
+):
+    """ACCEPTANCE: kill -9 one of two real backends mid-flight — the
+    gateway routes around it within the hysteresis window (availability
+    never reaches zero), the displaced session 404s then re-adapts to
+    bit-identical predictions (never stale), membership flap in the
+    gateway's events.jsonl."""
+    _run_drill("gateway-kill9-backend", tmp_path, fleet_template)
+
+
+def test_cross_process_sigterm_drain_spill_rehydrate(tmp_path, fleet_template):
+    """ACCEPTANCE: SIGTERM a real backend mid-load — zero dropped in-flight
+    requests, clean rc 0, sessions spilled digest-verified, and the
+    respawned replica serves the OLD adaptation id from its rehydrated
+    cache (post-restart cache hit, bit-identical probs)."""
+    _run_drill("gateway-drain-rehydrate", tmp_path, fleet_template)
+
+
+def test_cross_process_rolling_restart_under_load(tmp_path, fleet_template):
+    """ACCEPTANCE: scripts/rolling_restart.py drains + respawns both
+    backends one at a time under live load: the fleet keeps serving, both
+    come back warm (healthz-gated), and every non-200 the driver saw
+    resolves to a gateway access line by request id."""
+    _run_drill("gateway-rolling-restart", tmp_path, fleet_template)

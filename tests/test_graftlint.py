@@ -833,6 +833,37 @@ def test_self_gate_covers_request_tracing_paths_explicitly():
     )
 
 
+def test_self_gate_covers_multihost_fleet_paths_explicitly():
+    """The multi-host serving layer (ISSUE 14) sits inside the self-gate on
+    its own terms: the gateway's membership/session/counter state is shared
+    across HTTP handler threads and the health poller (GL201 territory),
+    its HTTP-code taxonomy must come from the registry (GL301 territory —
+    file-path-loaded to keep it import-light), and both CLIs are exit-code
+    consumers — zero unsuppressed findings even if the top-level path list
+    is ever restructured."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "gateway.py"
+                ),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "sessions.py"
+                ),
+                os.path.join("scripts", "gateway.py"),
+                os.path.join("scripts", "rolling_restart.py"),
+                os.path.join("scripts", "serve.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in multi-host fleet paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_covers_program_memory_paths_explicitly():
     """The program-memory round (ISSUE 12) sits inside the self-gate on
     its own terms: the bucket tuner + its CLI are exit-code consumers
