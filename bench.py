@@ -74,6 +74,26 @@ def _precision_overrides(knob: str) -> dict:
     )
 
 
+#: BENCH_STRATEGY arms (core/strategies.py): the train bench measures the
+#: gradient strategies only — "protonet" has no train step (forward-only
+#: serving tier; bench_serving.py measures it). "" keeps the flagship
+#: recipe's default exactly.
+_STRATEGY_KNOBS = ("", "maml++", "fomaml", "anil")
+
+
+def _strategy_overrides(knob: str) -> dict:
+    """Config kwargs for the BENCH_STRATEGY A/B knob: ``""`` keeps the
+    flagship recipe's default strategy (maml++ — the JSON line stays
+    comparable to prior rounds); an explicit name maps onto
+    ``Config.strategy`` so one armed session can measure the whole
+    speed/accuracy ladder (maml++ vs fomaml vs anil) off the same queue.
+    Validation happens in main() under the rc-2 usage contract, same as
+    BENCH_PRECISION/BENCH_REMAT."""
+    if knob in ("", "maml++"):
+        return {}
+    return {"strategy": knob}
+
+
 def _remat_overrides(knob: str) -> dict:
     """Config kwargs for the BENCH_REMAT A/B knob (ISSUE 12): ``""`` keeps
     the flagship recipe exactly as before (``remat_inner_steps=False`` —
@@ -316,6 +336,16 @@ class _Watchdog:
 
 
 def main():
+    # validated BEFORE any backend contact: a typo'd arm exits the clean
+    # rc-2 usage contract (one structured JSON line), never a traceback
+    # minutes into a tunnel wait — the BENCH_PRECISION/BENCH_REMAT contract
+    strategy_knob = os.environ.get("BENCH_STRATEGY", "")
+    if strategy_knob not in _STRATEGY_KNOBS:
+        _fail(
+            f"BENCH_STRATEGY must be one of {list(_STRATEGY_KNOBS)} "
+            f"('protonet' is forward-only — bench_serving.py measures it), "
+            f"got {strategy_knob!r}"
+        )
     platform, device_kind, n_devices = _contact_device()
     print(
         f"bench: platform={platform} device_kind={device_kind!r} n_devices={n_devices}",
@@ -400,19 +430,25 @@ def main():
     # BENCH_REMAT=none|full|dots_saveable|... A/Bs the inner-step remat
     # policy (peak program bytes vs recompute/compile seconds) on the same
     # flagship program; the default keeps the recipe's remat-off exactly.
+    # BENCH_STRATEGY=maml++|fomaml|anil A/Bs the adaptation strategy
+    # (core/strategies.py) on the same flagship shape: fomaml drops the
+    # second-order terms, anil shrinks the inner loop to the classifier
+    # head — the speed half of the registry's speed/accuracy ladder.
     cfg = Config(
         matmul_precision=os.environ.get("BENCH_MATMUL_PRECISION", "default"),
         conv_via_patches=os.environ.get("BENCH_CONV_VIA_PATCHES", "0") == "1",
         **_precision_overrides(os.environ.get("BENCH_PRECISION", "")),
         **_remat_overrides(os.environ.get("BENCH_REMAT", "")),
+        **_strategy_overrides(strategy_knob),
     )
     system = MAMLSystem(cfg)
     # program-variant markers, same contract as matmul_precision above: the
-    # resolved precision policy name ("legacy_bf16" | "f32" | "bf16_inner")
-    # and the resolved remat policy
+    # resolved precision policy name ("legacy_bf16" | "f32" | "bf16_inner"),
+    # the resolved remat policy, and the adaptation strategy
     wd.update(
         precision=system.precision.name,
         remat_policy=cfg.resolved_remat_policy,
+        strategy=cfg.strategy,
     )
     # collector-only compile ledger: every XLA compile this process pays is
     # timed and attributed, so the JSON line's `prewarm` breakdown (compile

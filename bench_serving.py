@@ -150,6 +150,21 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    # BENCH_STRATEGY, same rc-2 contract: the serving bench measures the
+    # FULL menu incl. the forward-only protonet tier (core/strategies.py) —
+    # one recorded JSON line per arm is the latency ladder. "" = maml++,
+    # the recipe exactly as before.
+    from howtotrainyourmamlpytorch_tpu.config import SERVING_STRATEGIES
+
+    strategy_knob = os.environ.get("BENCH_STRATEGY", "")
+    if strategy_knob not in ("",) + tuple(SERVING_STRATEGIES):
+        print(
+            f"bench_serving: bad BENCH_STRATEGY {strategy_knob!r} "
+            f"(valid: {sorted(SERVING_STRATEGIES)})",
+            file=sys.stderr,
+        )
+        return 2
+    strategy = strategy_knob or "maml++"
     cfg = Config(
         num_classes_per_set=args.n_way,
         num_samples_per_class=args.k_shot,
@@ -160,6 +175,9 @@ def main(argv=None) -> int:
         serving=ServingConfig(
             support_buckets=[support], query_buckets=[args.n_query],
             max_batch_size=args.batch,
+            # the benched strategy is the deployment's (only) configured
+            # one: the prewarm grid, planned set, and default all follow
+            strategies=[strategy],
         ),
     )
     stages, filters = (2, 4) if args.tiny else (4, 64)
@@ -253,6 +271,11 @@ def main(argv=None) -> int:
         "adapt_p95_ms": round(float(np.percentile(adapt_ms, 95)), 3),
         "cached_predict_p50_ms": round(float(np.percentile(predict_ms, 50)), 3),
         "cached_predict_p95_ms": round(float(np.percentile(predict_ms, 95)), 3),
+        # the per-strategy latency-ladder fields (one recorded line per
+        # BENCH_STRATEGY arm): predict_p50_ms aliases the cached-predict
+        # p50 under the ladder's canonical name
+        "strategy": strategy,
+        "predict_p50_ms": round(float(np.percentile(predict_ms, 50)), 3),
         "n_way": args.n_way,
         "k_shot": args.k_shot,
         "n_query": args.n_query,
@@ -285,12 +308,12 @@ def main(argv=None) -> int:
         "seconds": prewarm_summary["seconds"],
         "cache_hits": prewarm_summary["cache_hits"],
     }
-    # program keys are serve_predict/<query-bucket>/<task-batch>; take the
-    # widest-batch priced program (the throughput headline's dispatch shape)
+    # program keys are serve_predict[@strategy]/<query-bucket>/<task-batch>;
+    # take the widest-batch priced program (the headline's dispatch shape)
     flops_per_query = None
     best_batch = 0
     for name, p in summary["by_program"].items():
-        if not (name.startswith("serve_predict/") and p.get("flops")):
+        if not (name.startswith("serve_predict") and p.get("flops")):
             continue
         _, bucket, b = name.split("/")
         if int(b) > best_batch:

@@ -397,9 +397,14 @@ def prewarm_train(
     eval_stack = train_batch_spec(cfg, chunk_sharding, leading=(n_eval,))
     jobs: List[Tuple[str, Callable, Sequence[Any]]] = []
     # deterministic job order (plan is a set — sorted, or the pool order
-    # and the manifest would wander run to run)
+    # and the manifest would wander run to run). Kinds carry the system's
+    # strategy as an @-suffix for non-default strategies (config.kind_base
+    # strips it — the program identity keeps the suffix, the dispatch here
+    # only cares about the base).
+    from ..config import kind_base
+
     for key in sorted(plan, key=repr):
-        kind = key[0]
+        kind = kind_base(key[0])
         if kind == "train":
             fn, args = system._compiled_train_step(key[1], key[2]), (state_spec, batch)
         elif kind == "train_multi":
@@ -441,6 +446,7 @@ def prewarm_serving(
     THE warm path a fresh replica runs before accepting work (and what
     ``scripts/loadgen.py`` runs before its measurement clock starts —
     previously a hand-rolled duplicate of this grid)."""
+    from ..config import kind_base, kind_strategy
     from ..observability.compile_ledger import CompileLedger
     from ..utils.strictmode import serving_planned_programs
 
@@ -449,12 +455,16 @@ def prewarm_serving(
     h, w, c = image_shape or engine.cfg.image_shape
     params = engine.state.params
     plan = serving_planned_programs(engine.serving)
-    fw_specs: Dict[int, Any] = {}
+    fw_specs: Dict[Any, Any] = {}
     jobs: List[Tuple[str, Callable, Sequence[Any]]] = []
     for key in sorted(plan, key=repr):
         kind, bucket, b = key
-        if kind == "adapt":
-            fn = engine._compiled_adapt(bucket, b)
+        # the kind carries the strategy ("adapt@protonet") — the whole
+        # configured strategy menu prewarms through the same grid walk
+        base, strategy = kind_base(kind), kind_strategy(kind)
+        tag = getattr(engine, "ledger_tag", "")
+        if base == "adapt":
+            fn = engine._compiled_adapt(bucket, b, strategy=strategy)
             args = (
                 _sds((b, bucket, h, w, c), np.float32),
                 _sds((b, bucket), np.int32),
@@ -462,17 +472,30 @@ def prewarm_serving(
             )
             # the engine's ledger tag ("@r1" on fleet clones) keeps every
             # replica's rows distinct in merged prewarm/ledger tables
-            name = f"serve_adapt{getattr(engine, 'ledger_tag', '')}/{bucket}/{b}"
+            name = f"serve_{kind}{tag}/{bucket}/{b}"
         else:  # predict: per-item fast weights stacked on the task axis
-            fn = engine._compiled_predict(bucket, b)
-            if b not in fw_specs:
-                fw_specs[b] = shape_specs(params, leading=(b,))
+            fn = engine._compiled_predict(bucket, b, strategy=strategy)
+            # the per-item fast-weight tree is strategy-shaped: a prototype
+            # table for protonet, the full parameter tree otherwise
+            spec_key = ("protonet" if strategy == "protonet" else "params", b)
+            if spec_key not in fw_specs:
+                if strategy == "protonet":
+                    from ..core.strategies import protonet_prototype_shape
+
+                    fw_specs[spec_key] = {
+                        "prototypes": _sds(
+                            (b,) + protonet_prototype_shape(engine.num_classes),
+                            np.float32,
+                        )
+                    }
+                else:
+                    fw_specs[spec_key] = shape_specs(params, leading=(b,))
             args = (
-                fw_specs[b],
+                fw_specs[spec_key],
                 _sds((b, bucket, h, w, c), np.float32),
                 _sds((b, bucket), np.float32),
             )
-            name = f"serve_predict{getattr(engine, 'ledger_tag', '')}/{bucket}/{b}"
+            name = f"serve_{kind}{tag}/{bucket}/{b}"
         jobs.append((name, fn, args))
     return _run_warm_pool(
         jobs,

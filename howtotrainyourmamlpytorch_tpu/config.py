@@ -63,6 +63,36 @@ REMAT_POLICIES = (
     "dots_with_no_batch_dims_saveable",
 )
 
+# Adaptation strategies (core/strategies.py). Train-time strategies select
+# the inner-loop rollout the meta-objective differentiates through;
+# "protonet" is forward-only (adaptation is one embedding forward + a
+# class-prototype reduction — nothing to meta-train here) and so is valid
+# only on the serving menu. Kept as literals here (config.py stays
+# jax-free); core/strategies.py owns the math.
+TRAIN_STRATEGIES = ("maml++", "fomaml", "anil")
+SERVING_STRATEGIES = ("maml++", "fomaml", "anil", "protonet")
+DEFAULT_STRATEGY = "maml++"
+
+
+def strategy_kind(kind: str, strategy: str) -> str:
+    """Program-key kind with the strategy component attached. The default
+    strategy keeps the bare legacy spelling (``"train"``, ``"adapt"``) so a
+    default-config run's planned sets, ledger rows, manifest program names
+    and executable-store files are byte-identical to the pre-registry ones;
+    every other strategy is an explicit suffix (``"train@anil"``)."""
+    return kind if strategy == DEFAULT_STRATEGY else f"{kind}@{strategy}"
+
+
+def kind_base(kind: str) -> str:
+    """``"train@anil"`` -> ``"train"`` (the dispatch half of a kind)."""
+    return kind.split("@", 1)[0]
+
+
+def kind_strategy(kind: str) -> str:
+    """``"train@anil"`` -> ``"anil"``; bare kinds are the default strategy."""
+    return kind.split("@", 1)[1] if "@" in kind else DEFAULT_STRATEGY
+
+
 DATASET_PRESETS: Dict[str, DatasetConfig] = {
     "omniglot": DatasetConfig(name="omniglot_dataset", path="datasets/omniglot_dataset"),
     "imagenet": DatasetConfig(
@@ -152,6 +182,15 @@ class ServingConfig:
     # Inner steps per adapt request; 0 = the config's eval horizon
     # (number_of_evaluation_steps_per_iter), matching eval_step exactly.
     adapt_steps: int = 0
+    # The adaptation strategies this deployment serves (core/strategies.py):
+    # each request may name one (validated against this set; the first entry
+    # is the default for requests that don't). Every configured strategy's
+    # (bucket x batch-bucket) program grid is planned, prewarmed, and
+    # strict-guarded; a valid-but-unconfigured strategy in a request is an
+    # unplanned program — strict mode rejects it instead of silently
+    # compiling. The default ["maml++"] keeps the planned set, prewarm grid,
+    # and every program key byte-identical to the pre-registry engine.
+    strategies: List[str] = field(default_factory=lambda: ["maml++"])
     # HTTP front-end (scripts/serve.py)
     host: str = "127.0.0.1"
     port: int = 8100
@@ -176,6 +215,20 @@ class ServingConfig:
         self.query_buckets = sorted(int(b) for b in self.query_buckets)
         if any(b <= 0 for b in self.support_buckets + self.query_buckets):
             raise ValueError("serving buckets must be positive")
+        # normalize the strategy menu: dedupe preserving order (the first
+        # entry is the default strategy), validate every name
+        seen: List[str] = []
+        for s in self.strategies:
+            if s not in SERVING_STRATEGIES:
+                raise ValueError(
+                    f"serving.strategies entry {s!r} is not a known "
+                    f"strategy; valid: {list(SERVING_STRATEGIES)}"
+                )
+            if s not in seen:
+                seen.append(s)
+        if not seen:
+            raise ValueError("serving.strategies must name at least one strategy")
+        self.strategies = seen
         if self.max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got {self.max_batch_size}")
         if self.batch_deadline_ms < 0:
@@ -537,6 +590,17 @@ class Config:
                 f"test_ensemble_top_k ({self.test_ensemble_top_k}) cannot "
                 f"exceed max_models_to_save ({self.max_models_to_save})"
             )
+        if self.strategy not in TRAIN_STRATEGIES:
+            hint = (
+                " ('protonet' is forward-only — a serving tier, not a "
+                "trainable objective; put it in serving.strategies)"
+                if self.strategy == "protonet"
+                else ""
+            )
+            raise ValueError(
+                f"strategy must be one of {list(TRAIN_STRATEGIES)}{hint}, "
+                f"got {self.strategy!r}"
+            )
         if self.matmul_precision not in ("default", "high", "highest"):
             raise ValueError(
                 f"matmul_precision must be 'default', 'high' or 'highest', "
@@ -589,6 +653,18 @@ class Config:
     test_stream_uses_val_seed: bool = True
 
     # --- MAML++ core (reference config.yaml:41-56) ---
+    # Adaptation strategy the meta-objective trains (core/strategies.py):
+    #   "maml++"  the full second-order rollout — the default, bit-identical
+    #             to the pre-registry path (same jaxpr, same program keys)
+    #   "fomaml"  first-order MAML: stop-gradient on the inner grads, so the
+    #             second-order terms vanish from the train program. By
+    #             construction identical to maml++ with second_order=false.
+    #   "anil"    head-only inner loop (Raghu et al.): the scan carries only
+    #             the classifier-head fast weights — the inner backward and
+    #             the meta-graph through it shrink to the head.
+    # "protonet" is serving-only (ServingConfig.strategies): its adaptation
+    # is a forward pass, there is no inner loop to meta-train here.
+    strategy: str = "maml++"
     learnable_inner_opt_params: bool = True
     # Per-STEP learnable inner-opt hyperparams: original MAML++ LSLR learns a
     # separate lr per (tensor, inner step); the bamos fork regressed this to

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
-from ..config import Config
+from ..config import Config, strategy_kind
 from ..models import Model, build_model
 from ..ops import build_inner_optimizer
 from ..ops.losses import cross_entropy
@@ -105,6 +105,14 @@ class MAMLSystem:
 
     def __init__(self, cfg: Config, model: Optional[Model] = None):
         self.cfg = cfg
+        # adaptation strategy (core/strategies.py): which inner rollout the
+        # meta-objective differentiates through. "maml++" (default) keeps
+        # every code path below EXACTLY as it was — the strategy registry
+        # dispatches host-side before tracing, so the default jaxpr (and
+        # with it the persistent XLA cache) is bit-identical by
+        # construction. Program keys carry the strategy via strategy_kind:
+        # bare legacy kinds for the default, "train@anil"-style otherwise.
+        self.strategy = getattr(cfg, "strategy", "maml++")
         # conv implementation + pooling convention are baked into the model's
         # apply as explicit build parameters (VERDICT r4 weak #5: these were
         # process globals with last-constructed-system-wins semantics). A
@@ -248,9 +256,17 @@ class MAMLSystem:
         # every program build below is wrapped so its XLA compiles are timed
         # and priced; None (the default) keeps builds exactly as they were
         self.compile_ledger = None
-        self._note_program(("eval",))
-        self._eval_step = self._build_program(("eval",), lambda: jax.jit(self._eval_step_impl))
+        self._note_program((self._kind("eval"),))
+        self._eval_step = self._build_program(
+            (self._kind("eval"),), lambda: jax.jit(self._eval_step_impl)
+        )
         self._eval_multi = None
+
+    def _kind(self, base: str) -> str:
+        """Program-key kind for this system's strategy: the default keeps
+        the bare legacy spelling, so default-config ledger rows, manifest
+        names, and executable-store files are unchanged."""
+        return strategy_kind(base, self.strategy)
 
     def _note_program(self, key) -> None:
         if self.recompile_guard is not None:
@@ -275,7 +291,7 @@ class MAMLSystem:
             self.recompile_guard.ledger = ledger
         if ledger is not None:
             self._eval_step = self._build_program(
-                ("eval",), lambda: jax.jit(self._eval_step_impl)
+                (self._kind("eval"),), lambda: jax.jit(self._eval_step_impl)
             )
             self._eval_multi = None
 
@@ -347,9 +363,9 @@ class MAMLSystem:
             # a deliberate cache drop re-plans the same family: the variants
             # recompiled against the new programs are not violations
             self.recompile_guard.reset()
-        self._note_program(("eval",))  # re-jitted below: count the lowering
+        self._note_program((self._kind("eval"),))  # re-jitted below: count it
         self._eval_step = self._build_program(
-            ("eval",), lambda: jax.jit(self._eval_step_impl)
+            (self._kind("eval"),), lambda: jax.jit(self._eval_step_impl)
         )
         self._eval_multi = None
 
@@ -518,6 +534,16 @@ class MAMLSystem:
         the reference's post-annealing/eval path
         (few_shot_learning_system.py:246-251). Returns
         (task_loss, final_target_logits)."""
+        if self.strategy == "anil":
+            # head-only inner loop (core/strategies.py): same contract,
+            # same MSL/remat/precision composition, a far smaller meta-graph
+            from .strategies import anil_rollout
+
+            return anil_rollout(
+                self, params, bn_state, hparams, inner_state, x_support,
+                y_support, x_target, y_target, loss_weights, second_order,
+                num_steps, per_step_target,
+            )
         forward = lambda p, x: self._apply_forward(p, bn_state, x)
 
         if per_step_target:
@@ -710,7 +736,12 @@ class MAMLSystem:
 
     def use_second_order(self, epoch: int) -> bool:
         """Reference intent (few_shot_learning_system.py:288-289): second order
-        iff ``second_order`` and ``epoch > first_order_to_second_order_epoch``."""
+        iff ``second_order`` and ``epoch > first_order_to_second_order_epoch``.
+        The ``fomaml`` strategy IS this switch pinned False for the whole
+        run — its train program coincides with maml++'s
+        ``second_order=false`` variant by construction."""
+        if self.strategy == "fomaml":
+            return False
         return bool(
             self.cfg.second_order and epoch > self.cfg.first_order_to_second_order_epoch
         )
@@ -731,10 +762,10 @@ class MAMLSystem:
     def _compiled_train_step(self, second_order: bool, msl_active: bool):
         key = (second_order, msl_active)
         if key not in self._train_step_cache:
-            self._note_program(("train",) + key)
+            self._note_program((self._kind("train"),) + key)
             donate = self._donate_argnums()
             self._train_step_cache[key] = self._build_program(
-                ("train",) + key,
+                (self._kind("train"),) + key,
                 lambda: jax.jit(
                     functools.partial(
                         self._train_step_impl, second_order=second_order, msl_active=msl_active
@@ -771,6 +802,7 @@ class MAMLSystem:
         y_support,
         num_steps: Optional[int] = None,
         support_weight=None,
+        strategy: Optional[str] = None,
     ):
         """Inner-loop adaptation only: support set [S, H, W, C] / [S] ->
         adapted parameter pytree. First-order (no meta-gradient graph is ever
@@ -780,14 +812,42 @@ class MAMLSystem:
         eval-step target logits. ``support_weight`` masks padded samples out
         of the loss and the transductive-BN statistics (shape bucketing).
         Deliberately not jitted here — the serving engine jits per shape
-        bucket and task-batch size."""
+        bucket and task-batch size.
+
+        ``strategy`` picks the serving-side rollout PER CALL (the engine
+        serves an accuracy/latency menu from one checkpoint): None = this
+        system's own strategy; ``"maml++"``/``"fomaml"`` are the full
+        rollout (serving adaptation is already first-order, so they
+        coincide here); ``"anil"`` runs the head-only loop. ``"protonet"``
+        has no fast-weight rollout — use :meth:`protonet_adapt`."""
         cfg = self.cfg
+        strategy = self.strategy if strategy is None else strategy
+        if strategy == "protonet":
+            raise ValueError(
+                "protonet adaptation is a prototype reduction, not a "
+                "fast-weight rollout; use protonet_adapt/protonet_predict"
+            )
         if num_steps is None:
             num_steps = cfg.number_of_evaluation_steps_per_iter
         hparams = self._inner_hparams_for_rollout(state.inner_hparams, state.params)
         inner_state = self._initial_inner_state(
             state.params, hparams, state.opt_state
         )
+        if strategy == "anil":
+            from .strategies import anil_adapt_loop
+
+            return anil_adapt_loop(
+                self,
+                state.params,
+                state.bn_state,
+                hparams,
+                inner_state,
+                x_support,
+                y_support,
+                second_order=False,
+                num_steps=num_steps,
+                support_weight=support_weight,
+            )
         return self._adapt_loop(
             state.params,
             state.bn_state,
@@ -798,6 +858,28 @@ class MAMLSystem:
             second_order=False,
             num_steps=num_steps,
             support_weight=support_weight,
+        )
+
+    def protonet_adapt(self, state: TrainState, x_support, y_support,
+                       support_weight=None):
+        """ProtoNet ``adapt`` (core/strategies.py): one embedding forward +
+        masked class-prototype reduction -> ``{"prototypes": [n_way, D]}``
+        — the forward-only serving tier's session state. Zero gradients."""
+        from .strategies import protonet_prototypes
+
+        return protonet_prototypes(
+            self, state.params, state.bn_state, x_support, y_support,
+            support_weight,
+        )
+
+    def protonet_predict_logits(self, state_params, bn_state, prototypes,
+                                x_query, sample_weight=None):
+        """ProtoNet ``predict``: distance logits of a query batch against a
+        prototype table (master params embed the queries)."""
+        from .strategies import protonet_logits
+
+        return protonet_logits(
+            self, state_params, bn_state, prototypes, x_query, sample_weight
         )
 
     def predict_logits(self, fast_weights, bn_state, x, sample_weight=None):
@@ -825,10 +907,10 @@ class MAMLSystem:
     def _compiled_train_multi(self, second_order: bool, msl_active: bool):
         key = (second_order, msl_active)
         if key not in self._train_multi_cache:
-            self._note_program(("train_multi",) + key)
+            self._note_program((self._kind("train_multi"),) + key)
             donate = self._donate_argnums()
             self._train_multi_cache[key] = self._build_program(
-                ("train_multi",) + key,
+                (self._kind("train_multi"),) + key,
                 lambda: jax.jit(
                     functools.partial(
                         self._train_multi_impl, second_order=second_order, msl_active=msl_active
@@ -870,9 +952,9 @@ class MAMLSystem:
 
     def _compiled_eval_multi(self):
         if self._eval_multi is None:
-            self._note_program(("eval_multi",))
+            self._note_program((self._kind("eval_multi"),))
             self._eval_multi = self._build_program(
-                ("eval_multi",), lambda: jax.jit(self._eval_multi_impl)
+                (self._kind("eval_multi"),), lambda: jax.jit(self._eval_multi_impl)
             )
         return self._eval_multi
 

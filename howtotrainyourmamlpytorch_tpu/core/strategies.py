@@ -1,0 +1,317 @@
+"""The adaptation-strategy registry: one compiled engine, an accuracy/latency menu.
+
+``core/maml.py`` owns the full MAML++ rollout (the ``maml++`` strategy —
+untouched, jaxpr-pinned bit-identical); this module owns everything the other
+strategies do differently, compiled through the SAME program cache, shape
+buckets, strict-mode planned sets, AOT prewarm grid, and serving API:
+
+- ``fomaml`` — first-order MAML (the reference's ignored ``use_second_order``
+  knob, taken seriously): ``stop_gradient`` on the inner grads, so every
+  second-order term vanishes from the train program. Implemented by forcing
+  the existing rollout's ``second_order=False`` switch, which makes the
+  fomaml program *coincide by construction* with maml++ under
+  ``second_order=false`` (test-pinned jaxpr equality).
+
+- ``anil`` — Almost No Inner Loop (Raghu et al., "Rapid Learning or Feature
+  Reuse?"): the inner loop adapts ONLY the classifier head, selected by a
+  name-based partition of the parameter tree (:func:`split_head_body` — the
+  repo's backbones all name their head ``fc``/``classifier``). The scanned
+  rollout carries head fast weights only, so the inner backward and the
+  meta-gradient graph through the K-step update chain shrink from the whole
+  conv stack to one linear layer; body meta-gradients still flow through the
+  (undifferentiated-through-updates) forward passes, exactly the ANIL
+  objective. Composes with second order, MSL, remat policy, precision
+  policy, and LSLR (head hyperparameters sliced from the full tree, so the
+  TrainState layout — and therefore every checkpoint — is
+  strategy-independent).
+
+- ``protonet`` — Prototypical Networks (Snell et al.) as the forward-only
+  serving tier: ``adapt`` is one embedding forward + a masked class-prototype
+  reduction (zero gradients), ``predict`` is negative squared Euclidean
+  distance to the prototypes. The embedding is the meta-trained network's
+  output space (``D = num_classes`` — the head is part of the embedding
+  function), which keeps ``Model.apply`` opaque: any checkpoint serves a
+  protonet tier with no extra weights. Serving-only: there is no inner loop
+  to meta-train here (``Config.strategy`` rejects it; a ProtoNet *training*
+  objective would be a different episodic loss, out of scope).
+
+Program-key naming is owned by ``config.strategy_kind``: the default
+strategy keeps the bare legacy kind (``"train"``, ``"adapt"``) so a default
+config's planned sets / ledger rows / manifest names / executable-store
+files survive the registry untouched; every other strategy is an explicit
+``kind@strategy`` suffix.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import (  # noqa: F401 — re-exported as the registry surface
+    DEFAULT_STRATEGY,
+    SERVING_STRATEGIES,
+    TRAIN_STRATEGIES,
+    kind_base,
+    kind_strategy,
+    strategy_kind,
+)
+from ..ops.losses import cross_entropy
+
+#: top-level parameter-tree names that identify the classifier head — the
+#: ANIL partition is name-based so it works on every shipped backbone
+#: (vgg/resnet name it "fc", densenet "classifier") without the models
+#: declaring anything new
+HEAD_KEYS = ("fc", "classifier")
+
+
+# ---------------------------------------------------------------------------
+# ANIL: the head/body partition
+# ---------------------------------------------------------------------------
+
+
+def split_head_body(params: Dict[str, Any]) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Partition a parameter tree into (head, body) by top-level name.
+
+    The head is every top-level entry named in :data:`HEAD_KEYS`; the body
+    is the rest (the feature extractor). Raises with a clear message when
+    the tree has no recognizable head — a hand-built model without an
+    ``fc``/``classifier`` entry cannot run ANIL."""
+    if not isinstance(params, dict):
+        raise ValueError(
+            f"ANIL needs a dict parameter tree with a named head; got "
+            f"{type(params).__name__}"
+        )
+    head = {k: v for k, v in params.items() if k in HEAD_KEYS}
+    if not head:
+        raise ValueError(
+            f"ANIL head/body partition found no head entry (looked for "
+            f"{list(HEAD_KEYS)} among top-level keys {sorted(params)}); "
+            "name the classifier head 'fc' or 'classifier'"
+        )
+    body = {k: v for k, v in params.items() if k not in HEAD_KEYS}
+    return head, body
+
+
+def merge_head_body(head: Dict[str, Any], body: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`split_head_body` (top-level dict union)."""
+    return {**body, **head}
+
+
+def take_head(tree: Any) -> Any:
+    """Slice the head subtree out of every parameter-shaped level of a
+    derived tree (inner-optimizer hyperparameters like ``{"lr": params-like}``,
+    inner-optimizer state like ``{"exp_avg": params-like, ...}``). A dict
+    containing a head key IS a parameter-shaped level and is filtered there;
+    other containers recurse; leaves (and the SGD state's empty tuple) pass
+    through. The derived trees mirror ``params`` by construction
+    (``init_hparams(params)`` / ``init_state(params, ...)``), so the head
+    names appear at exactly the same level."""
+    if isinstance(tree, dict):
+        if any(k in tree for k in HEAD_KEYS):
+            return {k: v for k, v in tree.items() if k in HEAD_KEYS}
+        return {k: take_head(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(take_head(v) for v in tree)
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# ANIL: head-only rollouts (the strategy counterparts of
+# MAMLSystem._adapt_loop and the MSL branch of MAMLSystem._rollout)
+# ---------------------------------------------------------------------------
+
+
+def _anil_inner_update(system, body, bn_state, x_support, y_support,
+                       second_order, support_weight=None):
+    """``inner_update(head, opt_state, hp) -> (head', opt_state')`` — one
+    support-set gradient step on the HEAD only; the body rides into every
+    forward as a closed-over constant, so the backward pass stops at the
+    head and the scan's meta-graph carries one linear layer instead of the
+    conv stack."""
+
+    def inner_update(h, opt_s, hp):
+        def support_loss_fn(h_):
+            merged = merge_head_body(h_, body)
+            return cross_entropy(
+                system._apply_forward(merged, bn_state, x_support, support_weight),
+                y_support,
+                sample_weight=support_weight,
+            )
+
+        grads = jax.grad(support_loss_fn)(h)
+        if not second_order:
+            grads = jax.tree.map(lax.stop_gradient, grads)
+        return system.inner_opt.update(grads, opt_s, h, hp)
+
+    return inner_update
+
+
+def anil_adapt_loop(
+    system,
+    params,
+    bn_state,
+    hparams,
+    inner_state,
+    x_support,
+    y_support,
+    second_order: bool,
+    num_steps: int,
+    support_weight=None,
+):
+    """ANIL's ``_adapt_loop``: ``num_steps`` head-only support updates ->
+    full fast-weight tree (adapted head merged over the untouched body).
+    ``hparams``/``inner_state`` arrive as FULL trees (the TrainState layout
+    is strategy-independent, so checkpoints interchange) and are sliced to
+    the head here; the precision policy's rollout-entry cast applies to the
+    head carry and, once, to the closed-over body."""
+    from .maml import apply_remat_policy  # local: maml imports this module's callers lazily
+
+    head, body = split_head_body(params)
+    head = system.precision.cast_fast_weights(head)
+    body = system.precision.cast_fast_weights(body)
+    head_state = system.precision.cast_fast_weights(take_head(inner_state))
+    head_hp = take_head(hparams)
+    inner_update = _anil_inner_update(
+        system, body, bn_state, x_support, y_support, second_order, support_weight
+    )
+    hp_seq = system._hparam_sequence(head_hp, num_steps)
+    unroll = num_steps if system.cfg.unroll_inner_steps else 1
+
+    def step(carry, hp):
+        h, opt_s = carry
+        return inner_update(h, opt_s, hp), None
+
+    step = apply_remat_policy(step, system.cfg.resolved_remat_policy)
+    (h_final, _), _ = lax.scan(step, (head, head_state), hp_seq, unroll=unroll)
+    return merge_head_body(h_final, body)
+
+
+def anil_rollout(
+    system,
+    params,
+    bn_state,
+    hparams,
+    inner_state,
+    x_support,
+    y_support,
+    x_target,
+    y_target,
+    loss_weights,
+    second_order: bool,
+    num_steps: int,
+    per_step_target: bool,
+):
+    """ANIL's ``_rollout``: same (task_loss, final_target_logits) contract as
+    ``MAMLSystem._rollout``, with the head-only scan. The MSL annealing
+    window (``per_step_target``) forwards the target set through the merged
+    tree after every head update, weighted like maml++'s."""
+    forward = lambda p, x: system._apply_forward(p, bn_state, x)
+
+    if per_step_target:
+        from .maml import apply_remat_policy
+
+        head, body = split_head_body(params)
+        head = system.precision.cast_fast_weights(head)
+        body = system.precision.cast_fast_weights(body)
+        head_state = system.precision.cast_fast_weights(take_head(inner_state))
+        head_hp = take_head(hparams)
+        inner_update = _anil_inner_update(
+            system, body, bn_state, x_support, y_support, second_order
+        )
+        hp_seq = system._hparam_sequence(head_hp, num_steps)
+        unroll = num_steps if system.cfg.unroll_inner_steps else 1
+
+        def step(carry, xs):
+            weight, hp = xs
+            h, opt_s, _ = carry
+            h_new, opt_s_new = inner_update(h, opt_s, hp)
+            target_logits = forward(merge_head_body(h_new, body), x_target)
+            target_loss = cross_entropy(target_logits, y_target)
+            return (h_new, opt_s_new, target_logits), weight * target_loss
+
+        step = apply_remat_policy(step, system.cfg.resolved_remat_policy)
+        logits0 = jnp.zeros(
+            (x_target.shape[0], system.cfg.num_classes_per_set),
+            dtype=system.precision.logits_dtype,
+        )
+        (_, _, final_logits), weighted_losses = lax.scan(
+            step, (head, head_state, logits0), (loss_weights, hp_seq), unroll=unroll
+        )
+        return jnp.sum(weighted_losses), final_logits
+
+    p_final = anil_adapt_loop(
+        system, params, bn_state, hparams, inner_state, x_support, y_support,
+        second_order, num_steps,
+    )
+    final_logits = forward(p_final, x_target)
+    return cross_entropy(final_logits, y_target), final_logits
+
+
+# ---------------------------------------------------------------------------
+# ProtoNet: forward-only adapt (prototype reduction) + distance predict
+# ---------------------------------------------------------------------------
+
+
+def protonet_prototypes(
+    system, params, bn_state, x_support, y_support, support_weight=None
+) -> Dict[str, jnp.ndarray]:
+    """ProtoNet ``adapt``: one embedding forward over the support set + a
+    masked per-class mean — the "fast weights" are a prototype table
+    ``[num_classes, D]`` (``D = num_classes``: the embedding is the
+    network's f32 logit space). ``support_weight`` masks padded samples out
+    of both the prototype means and (via the forward) the transductive-BN
+    statistics, so shape bucketing stays prediction-invariant exactly like
+    the gradient strategies."""
+    z = system._apply_forward(params, bn_state, x_support, support_weight)
+    n_classes = system.cfg.num_classes_per_set
+    one_hot = jax.nn.one_hot(y_support, n_classes, dtype=z.dtype)
+    if support_weight is not None:
+        one_hot = one_hot * support_weight[:, None].astype(z.dtype)
+    counts = jnp.sum(one_hot, axis=0)  # [n_classes]
+    sums = one_hot.T @ z  # [n_classes, D]
+    protos = sums / jnp.maximum(counts, 1.0)[:, None]
+    return {"prototypes": protos}
+
+
+def protonet_logits(
+    system, params, bn_state, prototypes: Dict[str, jnp.ndarray], x_query,
+    sample_weight=None,
+):
+    """ProtoNet ``predict``: embed the query batch through the MASTER
+    parameters (the prototype table is the session state — the network is
+    shared by every session) and score each class as negative squared
+    Euclidean distance to its prototype. Softmax over these distance logits
+    is the Snell et al. posterior."""
+    z = system._apply_forward(params, bn_state, x_query, sample_weight)
+    c = prototypes["prototypes"]
+    d2 = jnp.sum((z[:, None, :] - c[None, :, :]) ** 2, axis=-1)
+    return -d2
+
+
+def protonet_prototype_shape(num_classes: int) -> Tuple[int, int]:
+    """The prototype-table shape for ``num_classes`` — the AOT prewarm grid
+    builds its fast-weight specs from this (compile/aot.py)."""
+    return (num_classes, num_classes)
+
+
+# ---------------------------------------------------------------------------
+# registry-surface helpers
+# ---------------------------------------------------------------------------
+
+
+def validate_request_strategy(name: Optional[str], configured) -> str:
+    """Resolve + validate a per-request strategy name: ``None`` means the
+    deployment's default (the first configured entry); an unknown name
+    raises ``ValueError`` — the serving layer maps that to HTTP 400. A
+    *valid but unconfigured* name passes through deliberately: its programs
+    are outside the planned set, which is strict mode's finding to make
+    (rejection, not a silent compile), and permissive mode's on-demand
+    compile — the same contract oversize shape buckets already have."""
+    if name is None:
+        return configured[0]
+    if name not in SERVING_STRATEGIES:
+        raise ValueError(
+            f"unknown strategy {name!r}; valid: {list(SERVING_STRATEGIES)}"
+        )
+    return name

@@ -70,6 +70,7 @@ class RequestContext:
     # -- filled in as the request moves through the serving path --------
     bucket: Any = None  # shape bucket the frontend routed to
     true_size: Optional[int] = None  # pre-padding sample count (waste acct)
+    strategy: Optional[str] = None  # adaptation strategy the request named
     replica: Optional[int] = None  # pool replica the router chose
     flush_batch: Optional[int] = None  # requests sharing the flush
     queue_wait_s: Optional[float] = None  # submit -> worker pickup
@@ -215,6 +216,7 @@ class AccessLog:
             "status": status,
             "bucket": ctx.bucket,
             "true_size": ctx.true_size,
+            "strategy": ctx.strategy,
             "replica": ctx.replica,
             "flush_batch": ctx.flush_batch,
             "cache_hit": ctx.cache_hit,

@@ -249,6 +249,13 @@ def episode_menu(rng: np.random.RandomState) -> List[Episode]:
         # result, the fleet must keep serving, and the death must resolve
         # through the access log (serving/pool.py, serving/router.py)
         Episode(kind="serve-replica-death", mode="serve"),
+        # mixed maml++/protonet traffic against ONE prewarmed strict-mode
+        # frontend (ISSUE 15, core/strategies.py): per-strategy cache
+        # isolation (no cross-strategy cache hit, wrong-strategy predict =
+        # honest 404), sealed-guard ZERO outside-prewarm compiles across
+        # the whole mixed stream, unknown strategy = 400 over the wire,
+        # and every non-200 resolvable to an access line
+        Episode(kind="serve-strategy-mix", mode="serve"),
         # --- cross-process fleet drills (ISSUE 14): a REAL gateway process
         # (scripts/gateway.py) in front of REAL serve backends (subprocess
         # interpreters running the actual run_server drain path). Marked
@@ -688,6 +695,155 @@ def _run_serve_episode(ep: Episode) -> List[str]:
         if not served_after:
             violations.append(
                 "no post-death access line names a surviving replica"
+            )
+    elif ep.kind == "serve-strategy-mix":
+        # Mixed-strategy traffic (maml++ + the forward-only protonet tier)
+        # against ONE strict-mode frontend whose whole strategy grid was
+        # prewarmed. Invariants: (1) per-strategy cache isolation — the
+        # same support set adapted under each strategy yields DISTINCT
+        # adaptation ids, the second adapt of each is a same-strategy
+        # cache hit, and a predict naming the wrong strategy for an id is
+        # an honest 404, never a cross-strategy result; (2) the sealed
+        # recompile guard sees ZERO outside-prewarm compiles across the
+        # whole mixed stream; (3) an unknown strategy is a 400 on the
+        # wire; (4) every non-200 resolves to an access-log line.
+        import dataclasses
+        import tempfile
+        import urllib.error
+        import urllib.request
+
+        from ..observability.context import read_access_log
+
+        mix_cfg = dataclasses.replace(
+            cfg,
+            strict_recompile_guard=True,
+            serving=ServingConfig(
+                support_buckets=[16], query_buckets=[16], max_batch_size=2,
+                strategies=["maml++", "protonet"],
+            ),
+        )
+        mix_system = MAMLSystem(
+            mix_cfg,
+            model=build_vgg(img, 5, num_stages=2, cnn_num_filters=4),
+        )
+        engine = AdaptationEngine(mix_system, mix_system.init_train_state())
+        warm = engine.prewarm(max_workers=1)
+        if warm["errors"]:
+            violations.append(f"strategy-grid prewarm errors: {warm}")
+        access_dir = tempfile.mkdtemp(prefix="chaos_access_")
+        frontend = ServingFrontend(engine, access_log_dir=access_dir)
+        server = make_http_server(frontend, "127.0.0.1", 0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        non_200_ids = []
+
+        def _post(path, body, timeout=60):
+            req = urllib.request.Request(
+                base + path,
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+
+        try:
+            epi2 = synthetic_batch(1, 5, 2, 3, img, seed=21)
+            x_s, y_s = epi2["x_support"][0], epi2["y_support"][0]
+            x_q = epi2["x_target"][0].reshape((-1,) + img)
+            payload = {"x_support": x_s.tolist(), "y_support": y_s.tolist()}
+            ids = {}
+            for strategy in ("maml++", "protonet"):
+                _, out = _post("/adapt", {**payload, "strategy": strategy})
+                if out.get("cached"):
+                    violations.append(f"first {strategy} adapt was a cache hit")
+                ids[strategy] = out["adaptation_id"]
+                _, again = _post("/adapt", {**payload, "strategy": strategy})
+                if not again.get("cached"):
+                    violations.append(
+                        f"repeat {strategy} adapt missed its own cache"
+                    )
+                _, probs = _post(
+                    "/predict",
+                    {"adaptation_id": ids[strategy], "x_query": x_q.tolist(),
+                     "strategy": strategy},
+                )
+            # (1) isolation: distinct ids; wrong-strategy predict = 404
+            if ids["maml++"] == ids["protonet"]:
+                violations.append(
+                    "maml++ and protonet produced the SAME adaptation id "
+                    "for one support set — cross-strategy cache collision"
+                )
+            try:
+                _post(
+                    "/predict",
+                    {"adaptation_id": ids["protonet"],
+                     "x_query": x_q.tolist(), "strategy": "maml++"},
+                )
+                violations.append(
+                    "predict with the wrong strategy for an id succeeded — "
+                    "a prototype table served through a gradient program"
+                )
+            except urllib.error.HTTPError as exc:
+                if exc.code != 404:
+                    violations.append(
+                        f"wrong-strategy predict returned {exc.code}, not 404"
+                    )
+                rid = exc.headers.get("X-Request-Id")
+                if rid:
+                    non_200_ids.append((exc.code, rid))
+            # (3) unknown strategy = 400 on the wire
+            try:
+                _post("/adapt", {**payload, "strategy": "bogus-tier"})
+                violations.append("unknown strategy adapt returned 200")
+            except urllib.error.HTTPError as exc:
+                if exc.code != 400:
+                    violations.append(
+                        f"unknown strategy returned {exc.code}, not 400"
+                    )
+                rid = exc.headers.get("X-Request-Id")
+                if rid:
+                    non_200_ids.append((exc.code, rid))
+            # (2) the sealed guard saw zero outside-prewarm compiles
+            snap = engine.recompile_guard.snapshot()
+            if not snap["prewarmed"] or snap["violations"]:
+                violations.append(
+                    f"sealed-guard invariant broken under mixed-strategy "
+                    f"traffic: {snap}"
+                )
+            metrics = frontend.metrics()
+            json.dumps(metrics)  # observability stays well-formed
+            mix = metrics.get("strategies") or {}
+            if set(mix) < {"maml++", "protonet"}:
+                violations.append(
+                    f"/metrics strategies block missing tiers: {sorted(mix)}"
+                )
+        finally:
+            server.shutdown()
+            server.server_close()
+            frontend.close()
+            thread.join(timeout=5)
+        # (4) every non-200 resolves to an access line
+        records, torn = read_access_log(os.path.join(access_dir, "access.jsonl"))
+        if torn:
+            violations.append(f"{torn} torn access.jsonl line(s)")
+        logged_ids = {r.get("trace_id") for r in records}
+        for code, rid in non_200_ids:
+            if rid not in logged_ids:
+                violations.append(
+                    f"non-200 ({code}) request {rid} has no access-log line"
+                )
+        if not non_200_ids:
+            violations.append(
+                "drill produced no non-200 responses — invariant untested"
+            )
+        strategies_logged = {
+            r.get("strategy") for r in records if r.get("strategy")
+        }
+        if strategies_logged < {"maml++", "protonet"}:
+            violations.append(
+                f"access lines do not carry both strategies: "
+                f"{sorted(strategies_logged)}"
             )
     else:
         violations.append(f"unknown serve episode kind {ep.kind!r}")

@@ -18,13 +18,21 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-CacheKey = Tuple[str, str]
+# (checkpoint fingerprint, adaptation strategy, support-set digest)
+CacheKey = Tuple[str, str, str]
 
 
-def support_digest(x_support, y_support, num_steps: int) -> str:
+def support_digest(
+    x_support, y_support, num_steps: int, strategy: str = "maml++"
+) -> str:
     """Content hash of one adapt request: support tensors + shapes + dtypes +
     the inner-step horizon (the same support set adapted for a different
-    number of steps is a different cache entry)."""
+    number of steps is a different cache entry) + the adaptation strategy —
+    a ProtoNet prototype table and a MAML fast-weight tree for the same
+    support set are different sessions, so their adaptation ids (and with
+    them every cache key, session-spill file, and gateway affinity hash)
+    never collide. The default strategy contributes nothing to the hash, so
+    every pre-registry adaptation id is unchanged."""
     h = hashlib.sha256()
     for arr in (x_support, y_support):
         a = np.ascontiguousarray(arr)
@@ -32,6 +40,8 @@ def support_digest(x_support, y_support, num_steps: int) -> str:
         h.update(str(a.dtype).encode())
         h.update(a.tobytes())
     h.update(str(int(num_steps)).encode())
+    if strategy != "maml++":
+        h.update(f"strategy:{strategy}".encode())
     return h.hexdigest()
 
 

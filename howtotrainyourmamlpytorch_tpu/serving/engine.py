@@ -25,8 +25,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import Config, ServingConfig, load_config
+from ..config import Config, ServingConfig, load_config, strategy_kind
 from ..core import MAMLSystem, TrainState
+from ..core.strategies import validate_request_strategy
 from ..experiment import checkpoint as ckpt
 from ..observability.context import flow_end
 from ..observability.trace import NULL_TRACER
@@ -119,11 +120,20 @@ class AdaptationEngine:
             or self.cfg.number_of_evaluation_steps_per_iter
         )
         self.num_classes = self.cfg.num_classes_per_set
-        # jit caches keyed by (padded size, task-batch bucket); device
+        # the adaptation-strategy menu this engine serves (ServingConfig
+        # .strategies; core/strategies.py): requests name one, the first
+        # entry is the default, and every configured strategy's program
+        # grid is planned/prewarmed/strict-guarded. The default ["maml++"]
+        # keeps every program key byte-identical to the pre-registry engine.
+        self.strategies = tuple(
+            getattr(self.serving, "strategies", None) or ("maml++",)
+        )
+        # jit caches keyed by (strategy, padded size, task-batch bucket);
+        # device
         # dispatch is serialized by the batcher's worker thread, but direct
         # engine calls (tests, bench) may race the dict — guard it.
-        self._adapt_jit: Dict[Tuple[int, int], Any] = {}
-        self._predict_jit: Dict[Tuple[int, int], Any] = {}
+        self._adapt_jit: Dict[Tuple[str, int, int], Any] = {}
+        self._predict_jit: Dict[Tuple[str, int, int], Any] = {}
         self._jit_lock = threading.Lock()
         # compile ledger (observability/compile_ledger.py): when set (ctor
         # param, or attribute assignment before the first request — the
@@ -204,49 +214,90 @@ class AdaptationEngine:
     # compiled programs
     # ------------------------------------------------------------------
 
-    def _compiled_adapt(self, support_size: int, batch: int):
-        key = (support_size, batch)
+    def _compiled_adapt(self, support_size: int, batch: int,
+                        strategy: Optional[str] = None):
+        strategy = strategy or self.strategies[0]
+        key = (strategy, support_size, batch)
         with self._jit_lock:
             fn = self._adapt_jit.get(key)
             if fn is None:
+                kind = strategy_kind("adapt", strategy)
                 if self.recompile_guard is not None:
-                    self.recompile_guard.note(("adapt",) + key)
+                    self.recompile_guard.note((kind, support_size, batch))
                 system, state, num_steps = self.system, self.state, self.num_steps
 
-                def adapt_batched(xs, ys, ws):
-                    return jax.vmap(
-                        lambda x, y, w: system.adapt_fast_weights(
-                            state, x, y, num_steps=num_steps, support_weight=w
-                        )
-                    )(xs, ys, ws)
+                if strategy == "protonet":
+                    # forward-only tier: one embedding forward + prototype
+                    # reduction per task — zero gradients in the program
+                    def adapt_batched(xs, ys, ws):
+                        return jax.vmap(
+                            lambda x, y, w: system.protonet_adapt(
+                                state, x, y, support_weight=w
+                            )
+                        )(xs, ys, ws)
+                else:
+                    def adapt_batched(xs, ys, ws):
+                        return jax.vmap(
+                            lambda x, y, w: system.adapt_fast_weights(
+                                state, x, y, num_steps=num_steps,
+                                support_weight=w, strategy=strategy,
+                            )
+                        )(xs, ys, ws)
 
                 fn = jax.jit(adapt_batched)
                 if self.compile_ledger is not None:
                     fn = self.compile_ledger.wrap_build(
-                        (f"serve_adapt{self.ledger_tag}",) + key, fn
+                        (
+                            f"{strategy_kind('serve_adapt', strategy)}"
+                            f"{self.ledger_tag}",
+                            support_size,
+                            batch,
+                        ),
+                        fn,
                     )
                 self._adapt_jit[key] = fn
         return fn
 
-    def _compiled_predict(self, query_size: int, batch: int):
-        key = (query_size, batch)
+    def _compiled_predict(self, query_size: int, batch: int,
+                          strategy: Optional[str] = None):
+        strategy = strategy or self.strategies[0]
+        key = (strategy, query_size, batch)
         with self._jit_lock:
             fn = self._predict_jit.get(key)
             if fn is None:
+                kind = strategy_kind("predict", strategy)
                 if self.recompile_guard is not None:
-                    self.recompile_guard.note(("predict",) + key)
-                system, bn_state = self.system, self.state.bn_state
+                    self.recompile_guard.note((kind, query_size, batch))
+                system, state = self.system, self.state
+                bn_state = state.bn_state
 
-                def predict_batched(fw, xs, ws):
-                    logits = jax.vmap(
-                        lambda p, x, w: system.predict_logits(p, bn_state, x, w)
-                    )(fw, xs, ws)
-                    return jax.nn.softmax(logits, axis=-1)
+                if strategy == "protonet":
+                    # fw is a prototype table per item; queries embed
+                    # through the shared master params
+                    def predict_batched(fw, xs, ws):
+                        logits = jax.vmap(
+                            lambda p, x, w: system.protonet_predict_logits(
+                                state.params, bn_state, p, x, w
+                            )
+                        )(fw, xs, ws)
+                        return jax.nn.softmax(logits, axis=-1)
+                else:
+                    def predict_batched(fw, xs, ws):
+                        logits = jax.vmap(
+                            lambda p, x, w: system.predict_logits(p, bn_state, x, w)
+                        )(fw, xs, ws)
+                        return jax.nn.softmax(logits, axis=-1)
 
                 fn = jax.jit(predict_batched)
                 if self.compile_ledger is not None:
                     fn = self.compile_ledger.wrap_build(
-                        (f"serve_predict{self.ledger_tag}",) + key, fn
+                        (
+                            f"{strategy_kind('serve_predict', strategy)}"
+                            f"{self.ledger_tag}",
+                            query_size,
+                            batch,
+                        ),
+                        fn,
                     )
                 self._predict_jit[key] = fn
         return fn
@@ -329,6 +380,8 @@ class AdaptationEngine:
                 # the engine's adapt/predict programs run under the same
                 # cast boundaries the system trained with
                 "precision": self.system.precision.name,
+                # the configured adaptation-strategy menu (first = default)
+                "strategies": list(self.strategies),
             }
         if self.recompile_guard is not None:
             out["recompile_guard"] = self.recompile_guard.snapshot()
@@ -375,13 +428,18 @@ class AdaptationEngine:
             if c is not None:
                 c.dispatch_s = seconds
 
-    def adapt_batch(self, items: List[Tuple[Any, Any]], ctxs=None):
+    def adapt_batch(self, items: List[Tuple[Any, Any]], ctxs=None,
+                    strategy: Optional[str] = None):
         """Adapt a same-bucket group of support sets in one device dispatch.
         ``items`` is a list of ``(x_support, y_support)``; returns one
         adapted-parameter pytree per item (device arrays, stackable into the
-        cache). ``ctxs`` (one RequestContext-or-None per item, threaded
-        through the batcher) get the dispatch seconds stamped and their
-        trace flows finished at the dispatch span."""
+        cache — a prototype table per item under ``strategy="protonet"``).
+        ``ctxs`` (one RequestContext-or-None per item, threaded through the
+        batcher) get the dispatch seconds stamped and their trace flows
+        finished at the dispatch span. ``strategy`` names the adaptation
+        strategy for the WHOLE group (the batcher never mixes strategies in
+        one flush — the group key carries it); None = the engine default."""
+        strategy = validate_request_strategy(strategy, self.strategies)
         self.injector.fire("serving.dispatch")
         flat = [self._flatten_support(x, y) for x, y in items]
         sizes = {x.shape[0] for x, _ in flat}
@@ -398,26 +456,30 @@ class AdaptationEngine:
         b = _batch_bucket(n, self.serving.max_batch_size)
         while len(xs) < b:  # pad the task axis by replicating the last task
             xs.append(xs[-1]); ys.append(ys[-1]); ws.append(ws[-1])
-        fn = self._compiled_adapt(bucket, b)
+        fn = self._compiled_adapt(bucket, b, strategy=strategy)
         t0 = time.monotonic()
         with self.tracer.span(
             "serve.adapt_dispatch", flows=self._dispatch_flows(ctxs),
-            batch=n, bucket=bucket,
+            batch=n, bucket=bucket, strategy=strategy,
         ):
             stacked = fn(np.stack(xs), np.stack(ys), np.stack(ws))
         self._stamp_dispatch(ctxs, time.monotonic() - t0)
         return [jax.tree.map(lambda a, i=i: a[i], stacked) for i in range(n)]
 
-    def adapt(self, x_support, y_support):
+    def adapt(self, x_support, y_support, strategy: Optional[str] = None):
         """Single-task convenience wrapper over :meth:`adapt_batch`."""
-        return self.adapt_batch([(x_support, y_support)])[0]
+        return self.adapt_batch([(x_support, y_support)], strategy=strategy)[0]
 
-    def predict_batch(self, items: List[Tuple[Any, Any]], ctxs=None) -> List[np.ndarray]:
+    def predict_batch(self, items: List[Tuple[Any, Any]], ctxs=None,
+                      strategy: Optional[str] = None) -> List[np.ndarray]:
         """Forward a same-bucket group of query batches, each through its own
         adapted weights, in one device dispatch. ``items`` is a list of
         ``(fast_weights, x_query)``; returns per-item softmax probabilities
-        [Q_i, num_classes] as host arrays, padding sliced off. ``ctxs`` as
-        in :meth:`adapt_batch`."""
+        [Q_i, num_classes] as host arrays, padding sliced off. ``ctxs`` and
+        ``strategy`` as in :meth:`adapt_batch` (the fast weights must come
+        from the SAME strategy's adapt — a prototype table only scores
+        through the protonet predict program)."""
+        strategy = validate_request_strategy(strategy, self.strategies)
         self.injector.fire("serving.dispatch")
         # parses host-side request payloads (JSON-decoded lists), not device
         # values  # graftlint: disable=GL110
@@ -435,11 +497,11 @@ class AdaptationEngine:
         while len(xs) < b:
             xs.append(xs[-1]); ws.append(ws[-1]); trees.append(trees[-1])
         stacked_fw = jax.tree.map(lambda *leaves: jnp.stack(leaves), *trees)
-        fn = self._compiled_predict(bucket, b)
+        fn = self._compiled_predict(bucket, b, strategy=strategy)
         t0 = time.monotonic()
         with self.tracer.span(
             "serve.predict_dispatch", flows=self._dispatch_flows(ctxs),
-            batch=n, bucket=bucket,
+            batch=n, bucket=bucket, strategy=strategy,
         ):
             # deliberate sync: predictions must land host-side to serialize
             # back to clients — this is the flush's one device round-trip
@@ -448,6 +510,7 @@ class AdaptationEngine:
         self._stamp_dispatch(ctxs, time.monotonic() - t0)
         return [probs[i, : sizes[i]] for i in range(n)]
 
-    def predict(self, fast_weights, x_query) -> np.ndarray:
+    def predict(self, fast_weights, x_query,
+                strategy: Optional[str] = None) -> np.ndarray:
         """Single-request convenience wrapper over :meth:`predict_batch`."""
-        return self.predict_batch([(fast_weights, x_query)])[0]
+        return self.predict_batch([(fast_weights, x_query)], strategy=strategy)[0]

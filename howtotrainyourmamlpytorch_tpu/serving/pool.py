@@ -32,6 +32,15 @@ from .cache import AdaptedWeightCache
 from .errors import ServiceUnavailableError
 
 
+def _key_strategy(key) -> "str | None":
+    """Strategy component of a batcher group key: ``(strategy, bucket)``
+    tuples carry one; bare buckets (legacy callers, tests) mean the engine
+    default (None)."""
+    if isinstance(key, tuple) and len(key) == 2 and isinstance(key[0], str):
+        return key[0]
+    return None
+
+
 class EngineReplica:
     """One serving failure domain: engine + batchers + breaker + cache."""
 
@@ -68,9 +77,14 @@ class EngineReplica:
         # of the observability contract single-replica consumers pin
         suffix = "" if solo else f"-r{self.index}"
         continuous = getattr(serving_cfg, "continuous_batching", False)
+        # the batcher group key is either a bare shape bucket (legacy
+        # callers/tests) or (strategy, bucket) from the frontend — requests
+        # of different adaptation strategies compile different programs and
+        # must never share a flush, so the strategy rides the grouping key
+        # and is unpacked here for the engine
         self.adapt_batcher = MicroBatcher(
-            lambda bucket, payloads, ctxs: self.engine.adapt_batch(
-                payloads, ctxs=ctxs
+            lambda key, payloads, ctxs: self.engine.adapt_batch(
+                payloads, ctxs=ctxs, strategy=_key_strategy(key)
             ),
             max_batch=serving_cfg.max_batch_size,
             deadline_ms=serving_cfg.batch_deadline_ms,
@@ -81,8 +95,8 @@ class EngineReplica:
             continuous=continuous,
         )
         self.predict_batcher = MicroBatcher(
-            lambda bucket, payloads, ctxs: self.engine.predict_batch(
-                payloads, ctxs=ctxs
+            lambda key, payloads, ctxs: self.engine.predict_batch(
+                payloads, ctxs=ctxs, strategy=_key_strategy(key)
             ),
             max_batch=serving_cfg.max_batch_size,
             deadline_ms=serving_cfg.batch_deadline_ms,

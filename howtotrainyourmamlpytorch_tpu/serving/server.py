@@ -45,6 +45,7 @@ from ..observability.context import (
 )
 from ..observability.metrics import prometheus_text
 from ..observability.trace import NULL_TRACER
+from ..core.strategies import validate_request_strategy
 from ..resilience.retry import DeadlineExceededError
 from ..resilience.watchdog import HeartbeatWatchdog
 from .cache import support_digest
@@ -350,8 +351,19 @@ class ServingFrontend:
 
     # ------------------------------------------------------------------
 
-    def _cache_key(self, digest: str) -> Tuple[str, str]:
-        return (self.engine.fingerprint, digest)
+    def _cache_key(self, digest: str, strategy: str) -> Tuple[str, str, str]:
+        """Adapted-session cache key: (checkpoint fingerprint, strategy,
+        adaptation id). The strategy is an explicit component — a ProtoNet
+        prototype table and a MAML fast-weight tree for the same support
+        set must never collide — on top of being folded into the digest
+        itself (serving/cache.py::support_digest)."""
+        return (self.engine.fingerprint, strategy, digest)
+
+    def _count_strategy(self, strategy: str, verb: str, outcome: str) -> None:
+        """Per-strategy outcome tally (the /metrics ``strategies`` block and
+        obs_top's live strategy mix read these): one increment per request,
+        keyed ``serving.strategy.<name>.<verb>.<outcome>``."""
+        self.hub.registry.inc(f"serving.strategy.{strategy}.{verb}.{outcome}")
 
     def _request_ctx(self, ctx: Optional[RequestContext]) -> Optional[RequestContext]:
         """The per-request trace identity: adopt the caller's (HTTP layer,
@@ -413,7 +425,9 @@ class ServingFrontend:
         arbitrary batcher."""
         return self.pool.replicas[0].dispatch(batcher, bucket, payload, ctx)
 
-    def _note_padding(self, verb: str, true_size: int, bucket) -> None:
+    def _note_padding(
+        self, verb: str, true_size: int, bucket, strategy: Optional[str] = None
+    ) -> None:
         """Padding-waste accounting (ROADMAP 4d): forward FLOPs scale with
         the PADDED sample count, so the wasted-FLOPs fraction over traffic
         is ``1 - true_samples / padded_samples``. Called AFTER a dispatch
@@ -434,6 +448,17 @@ class ServingFrontend:
             f"serving.padding.{verb}.bucket.{int(bucket)}.true_samples",
             int(true_size),
         )
+        if strategy:
+            # per-strategy tallies under their own prefix (the legacy
+            # per-verb keys above stay the aggregate the tuner reads)
+            reg.inc(
+                f"serving.padding.strategy.{strategy}.{verb}.true_samples",
+                int(true_size),
+            )
+            reg.inc(
+                f"serving.padding.strategy.{strategy}.{verb}.padded_samples",
+                int(bucket),
+            )
         true_total = sum(
             reg.counter(f"serving.padding.{v}.true_samples")
             for v in ("adapt", "predict")
@@ -481,6 +506,36 @@ class ServingFrontend:
                 by_bucket[verb] = rows
         if by_bucket:
             out["by_bucket"] = by_bucket
+        # per-strategy true/padded totals + waste — "which tier pads most"
+        by_strategy: Dict[str, Dict[str, Any]] = {}
+        for name, value in reg.counters("serving.padding.strategy.").items():
+            s, _, rest = name.partition(".")  # rest = "<verb>.<field>"
+            _, _, field = rest.partition(".")
+            row = by_strategy.setdefault(
+                s, {"true_samples": 0, "padded_samples": 0}
+            )
+            if field in row:
+                row[field] += value
+        for row in by_strategy.values():
+            row["padding_waste_frac"] = (
+                round(1.0 - row["true_samples"] / row["padded_samples"], 4)
+                if row["padded_samples"]
+                else None
+            )
+        if by_strategy:
+            out["by_strategy"] = by_strategy
+        return out
+
+    def strategy_stats(self) -> Dict[str, Any]:
+        """The /metrics ``strategies`` block: per-strategy request/outcome
+        tallies (one ``<verb>.<outcome>`` counter bump per request) — the
+        live "which tier is eating the fleet" mix obs_top renders."""
+        out: Dict[str, Any] = {}
+        for name, value in self.hub.registry.counters("serving.strategy.").items():
+            s, _, rest = name.partition(".")  # rest = "<verb>.<outcome>"
+            row = out.setdefault(s, {"requests": 0})
+            row[rest] = row.get(rest, 0) + value
+            row["requests"] += value
         return out
 
     def kill_replica(self, index: int, reason: str = "operator") -> None:
@@ -645,11 +700,17 @@ class ServingFrontend:
         ttl_s = float(self.serving.cache_ttl_s)
         for replica in self.pool.replicas:
             for key, tree, age_s in replica.cache.snapshot_entries():
-                fingerprint, digest = key
+                fingerprint, strategy, digest = key
                 if fingerprint != self.engine.fingerprint:
                     continue
+                if strategy == "protonet":
+                    # a prototype table is one forward pass to recompute —
+                    # not worth a spill file (and the rehydrate template is
+                    # the parameter tree, which it doesn't match)
+                    continue
                 self.session_store.spill(
-                    digest, tree, fingerprint, age_s=age_s, ttl_s=ttl_s
+                    digest, tree, fingerprint, age_s=age_s, ttl_s=ttl_s,
+                    strategy=strategy,
                 )
                 count += 1
         if count:
@@ -666,14 +727,16 @@ class ServingFrontend:
             fingerprint=self.engine.fingerprint,
             template=self.engine.state.params,
         )
-        for digest, tree, lived_s in entries:
+        for digest, tree, lived_s, strategy in entries:
             replica = max(
                 self.pool.replicas,
                 key=lambda r: rendezvous_score(digest, r.index),
             )
             # back-date by the TTL budget already consumed: a restart must
             # never extend a session's original expiry
-            replica.cache.put(self._cache_key(digest), tree, age_s=lived_s)
+            replica.cache.put(
+                self._cache_key(digest, strategy), tree, age_s=lived_s
+            )
         self._session_stats = dict(stats, rehydrated=stats["loaded"])
         if any(stats.values()):
             self._event("sessions_rehydrated", **stats)
@@ -684,8 +747,21 @@ class ServingFrontend:
                 flush=True,
             )
 
-    def adapt(self, x_support, y_support, ctx: Optional[RequestContext] = None) -> Dict[str, Any]:
+    def adapt(
+        self,
+        x_support,
+        y_support,
+        ctx: Optional[RequestContext] = None,
+        strategy: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        # strategy resolution BEFORE the logged/gated section: an unknown
+        # name raises ValueError here, which the HTTP layer maps to 400 +
+        # its own bad_request access line (a valid-but-unconfigured name
+        # passes — strict mode rejects its unplanned program downstream)
+        strategy = validate_request_strategy(strategy, self.engine.strategies)
         ctx = self._request_ctx(ctx)
+        if ctx is not None:
+            ctx.strategy = strategy
         t0 = time.monotonic()
         entered = False
         try:
@@ -702,8 +778,8 @@ class ServingFrontend:
                 trace=ctx.trace_id if ctx else None,
             ):
                 x, y = self.engine._flatten_support(x_support, y_support)
-                digest = support_digest(x, y, self.engine.num_steps)
-                key = self._cache_key(digest)
+                digest = support_digest(x, y, self.engine.num_steps, strategy)
+                key = self._cache_key(digest, strategy)
                 # affinity on the cache key: this session's fast weights
                 # live (or will live) on exactly this replica's cache
                 replica = self.router.route(digest, ctx=ctx)
@@ -717,13 +793,17 @@ class ServingFrontend:
                     if ctx is not None:
                         ctx.bucket = bucket
                         ctx.true_size = int(x.shape[0])
+                    # the batcher group key carries the strategy: requests
+                    # of different strategies compile different programs
+                    # and must never share a flush
                     fast_weights = replica.dispatch(
-                        replica.adapt_batcher, bucket, (x, y), ctx
+                        replica.adapt_batcher, (strategy, bucket), (x, y), ctx
                     )
-                    self._note_padding("adapt", x.shape[0], bucket)
+                    self._note_padding("adapt", x.shape[0], bucket, strategy)
                     replica.cache.put(key, fast_weights)
         except BaseException as exc:
             outcome, status = self._failure_of(exc)
+            self._count_strategy(strategy, "adapt", outcome)
             self._record_access(ctx, "adapt", outcome, status, time.monotonic() - t0)
             raise
         finally:
@@ -731,10 +811,16 @@ class ServingFrontend:
                 self._exit_request()
         elapsed = time.monotonic() - t0
         self.latency.record("adapt_cached" if cached else "adapt", elapsed)
+        if strategy != self.engine.strategies[0]:
+            # non-default strategies get their own latency phase on top of
+            # the aggregate (the default keeps the historical schema alone)
+            self.latency.record(f"adapt@{strategy}", elapsed)
+        self._count_strategy(strategy, "adapt", "ok")
         self._record_access(ctx, "adapt", "ok", 200, elapsed)
         out = {
             "adaptation_id": digest,
             "cached": cached,
+            "strategy": strategy,
             "support_size": int(x.shape[0]),
             "latency_ms": round(elapsed * 1e3, 3),
         }
@@ -743,8 +829,17 @@ class ServingFrontend:
             out["timing"] = ctx.timing_ms(elapsed)
         return out
 
-    def predict(self, adaptation_id: str, x_query, ctx: Optional[RequestContext] = None) -> np.ndarray:
+    def predict(
+        self,
+        adaptation_id: str,
+        x_query,
+        ctx: Optional[RequestContext] = None,
+        strategy: Optional[str] = None,
+    ) -> np.ndarray:
+        strategy = validate_request_strategy(strategy, self.engine.strategies)
         ctx = self._request_ctx(ctx)
+        if ctx is not None:
+            ctx.strategy = strategy
         t0 = time.monotonic()
         entered = False
         try:
@@ -759,14 +854,19 @@ class ServingFrontend:
                 # lands on the replica whose cache holds them. After a
                 # replica death the key remaps and the miss below is the
                 # honest failover answer: re-adapt, never a stale result.
+                # A predict naming the WRONG strategy for its id misses the
+                # (fingerprint, strategy, id) key the same honest way — a
+                # prototype table is never pushed through a gradient
+                # strategy's predict program, or vice versa.
                 replica = self.router.route(adaptation_id, ctx=ctx)
                 fast_weights = replica.cache.get(
-                    self._cache_key(adaptation_id), ctx=ctx
+                    self._cache_key(adaptation_id, strategy), ctx=ctx
                 )
                 if fast_weights is None:
                     raise UnknownAdaptationError(
-                        f"unknown or expired adaptation_id {adaptation_id!r}; "
-                        "re-send the support set via /adapt"
+                        f"unknown or expired adaptation_id {adaptation_id!r} "
+                        f"for strategy {strategy!r}; re-send the support set "
+                        "via /adapt"
                     )
                 self.router.admit(replica)
                 x = np.asarray(x_query, np.float32)
@@ -775,11 +875,13 @@ class ServingFrontend:
                     ctx.bucket = bucket
                     ctx.true_size = int(x.shape[0])
                 probs = replica.dispatch(
-                    replica.predict_batcher, bucket, (fast_weights, x), ctx
+                    replica.predict_batcher, (strategy, bucket),
+                    (fast_weights, x), ctx,
                 )
-                self._note_padding("predict", x.shape[0], bucket)
+                self._note_padding("predict", x.shape[0], bucket, strategy)
         except BaseException as exc:
             outcome, status = self._failure_of(exc)
+            self._count_strategy(strategy, "predict", outcome)
             self._record_access(ctx, "predict", outcome, status, time.monotonic() - t0)
             raise
         finally:
@@ -787,18 +889,30 @@ class ServingFrontend:
                 self._exit_request()
         elapsed = time.monotonic() - t0
         self.latency.record("predict", elapsed)
+        if strategy != self.engine.strategies[0]:
+            self.latency.record(f"predict@{strategy}", elapsed)
+        self._count_strategy(strategy, "predict", "ok")
         self._record_access(ctx, "predict", "ok", 200, elapsed)
         return probs
 
-    def adapt_predict(self, x_support, y_support, x_query, ctx: Optional[RequestContext] = None) -> Dict[str, Any]:
+    def adapt_predict(
+        self,
+        x_support,
+        y_support,
+        x_query,
+        ctx: Optional[RequestContext] = None,
+        strategy: Optional[str] = None,
+    ) -> Dict[str, Any]:
         # one client call, two hops: both access-log lines (verb adapt +
         # verb predict) share the request's trace id
         ctx = self._request_ctx(ctx)
         t0 = time.monotonic()
-        info = self.adapt(x_support, y_support, ctx=ctx)
+        info = self.adapt(x_support, y_support, ctx=ctx, strategy=strategy)
         if ctx is not None:
             ctx.access_logged = False  # the predict hop logs its own line
-        probs = self.predict(info["adaptation_id"], x_query, ctx=ctx)
+        probs = self.predict(
+            info["adaptation_id"], x_query, ctx=ctx, strategy=strategy
+        )
         if ctx is not None:
             # adapt() stamped an adapt-hop-only breakdown into info; the
             # response must describe the WHOLE request (queue/dispatch from
@@ -872,6 +986,7 @@ class ServingFrontend:
             "router": self.router.stats(),
             "replicas": self.pool.stats(),
             "padding": self.padding_stats(),
+            "strategies": self.strategy_stats(),
             "resilience": {
                 **self.counters.snapshot(),
                 "breaker": self.breaker.snapshot(),
@@ -1061,11 +1176,21 @@ class _Handler(BaseHTTPRequestHandler):
                 # be misparsed as the client's next request
                 req = self._read_json()
                 frontend.engine.injector.fire("serving.http")
+                # optional per-request strategy (core/strategies.py): absent
+                # = the deployment default; unknown name => ValueError =>
+                # the 400 branch below — the wire contract for a typo'd tier
+                strategy = req.get("strategy")
                 if self.path == "/adapt":
-                    out = frontend.adapt(req["x_support"], req["y_support"], ctx=ctx)
+                    out = frontend.adapt(
+                        req["x_support"], req["y_support"], ctx=ctx,
+                        strategy=strategy,
+                    )
                     self._send_json(200, out)
                 elif self.path == "/predict":
-                    probs = frontend.predict(req["adaptation_id"], req["x_query"], ctx=ctx)
+                    probs = frontend.predict(
+                        req["adaptation_id"], req["x_query"], ctx=ctx,
+                        strategy=strategy,
+                    )
                     body = {"probs": probs.tolist()}
                     if ctx is not None:
                         body["trace_id"] = ctx.trace_id
@@ -1073,7 +1198,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(200, body)
                 elif self.path == "/adapt_predict":
                     out = frontend.adapt_predict(
-                        req["x_support"], req["y_support"], req["x_query"], ctx=ctx
+                        req["x_support"], req["y_support"], req["x_query"],
+                        ctx=ctx, strategy=strategy,
                     )
                     out["probs"] = out["probs"].tolist()
                     self._send_json(200, out)

@@ -63,16 +63,21 @@ class SessionStore:
         age_s: float,
         ttl_s: float,
         wall_clock: Callable[[], float] = time.time,
+        strategy: str = "maml++",
     ) -> str:
         """Write one session (its adapted-parameter pytree) atomically,
         digest-wrapped. ``age_s`` is how long the entry had already lived in
         the cache; with ``ttl_s`` it lets the rehydrating process honor the
-        ORIGINAL expiry across the restart."""
+        ORIGINAL expiry across the restart. ``strategy`` is the adaptation
+        strategy the tree belongs to (core/strategies.py) — the rehydrating
+        cache keys on it, so a session can only ever be served back through
+        the strategy that produced it."""
         os.makedirs(self.root, exist_ok=True)
         body = serialization.msgpack_serialize(
             {
                 "digest": str(digest),
                 "fingerprint": str(fingerprint),
+                "strategy": str(strategy),
                 "saved_at": float(wall_clock()),
                 "age_s": float(age_s),
                 "ttl_s": float(ttl_s),
@@ -97,18 +102,20 @@ class SessionStore:
         fingerprint: str,
         template: Any,
         wall_clock: Callable[[], float] = time.time,
-    ) -> Tuple[List[Tuple[str, Any, float]], Dict[str, int]]:
-        """-> (``[(digest, tree, lived_s)]`` safe to serve, stats).
+    ) -> Tuple[List[Tuple[str, Any, float, str]], Dict[str, int]]:
+        """-> (``[(digest, tree, lived_s, strategy)]`` safe to serve, stats).
         Digest-verified; corrupt => quarantined ``*.corrupt``; TTL-lapsed
         => removed and counted ``stale``; other-checkpoint entries counted
         ``foreign`` and left for a replica of that checkpoint. ``lived_s``
         is how much TTL budget the session has already consumed (cache age
         before spill + wall time parked on disk) — the rehydrating cache
         back-dates the entry with it, so a restart never extends a
-        session's original expiry. Loaded files are consumed (removed) —
-        they are live cache entries again."""
+        session's original expiry. ``strategy`` is the adaptation strategy
+        recorded at spill (files from before the registry read as the
+        default). Loaded files are consumed (removed) — they are live cache
+        entries again."""
         stats = {"loaded": 0, "stale": 0, "corrupt": 0, "foreign": 0}
-        entries: List[Tuple[str, Any, float]] = []
+        entries: List[Tuple[str, Any, float, str]] = []
         if not os.path.isdir(self.root):
             return entries, stats
         for name in sorted(os.listdir(self.root)):
@@ -139,7 +146,10 @@ class SessionStore:
                 os.replace(path, path + ".corrupt")
                 stats["corrupt"] += 1
                 continue
-            entries.append((payload["digest"], tree, lived_s))
+            entries.append(
+                (payload["digest"], tree, lived_s,
+                 str(payload.get("strategy", "maml++")))
+            )
             stats["loaded"] += 1
             os.remove(path)
         return entries, stats

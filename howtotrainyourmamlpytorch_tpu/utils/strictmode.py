@@ -290,35 +290,62 @@ def batch_buckets(max_batch: int) -> Tuple[int, ...]:
 
 def serving_planned_programs(serving_cfg) -> Set[Tuple[str, int, int]]:
     """Every (kind, shape-bucket, batch-bucket) program the engine's bucket
-    tables plan for. A request larger than the largest bucket compiles its
-    exact shape on demand — correct, but *unplanned*: strict mode exists to
-    make exactly that loud."""
+    tables plan for, enumerated PER CONFIGURED STRATEGY
+    (``ServingConfig.strategies``; core/strategies.py): each strategy's
+    (adapt|predict) grid is a distinct compiled family, keyed through
+    ``config.strategy_kind`` — the default strategy keeps the bare legacy
+    kinds, so a ``["maml++"]`` deployment's planned set is byte-identical
+    to the pre-registry one. A request larger than the largest bucket (or
+    naming a valid-but-unconfigured strategy) compiles its exact program on
+    demand — correct, but *unplanned*: strict mode exists to make exactly
+    that loud."""
+    from ..config import strategy_kind  # local: keep module deps one-way
+
     batches = batch_buckets(serving_cfg.max_batch_size)
+    strategies = tuple(getattr(serving_cfg, "strategies", None) or ("maml++",))
     planned: Set[Tuple[str, int, int]] = set()
-    for bucket in serving_cfg.support_buckets:
-        planned.update(("adapt", bucket, b) for b in batches)
-    for bucket in serving_cfg.query_buckets:
-        planned.update(("predict", bucket, b) for b in batches)
+    for strategy in strategies:
+        adapt_kind = strategy_kind("adapt", strategy)
+        predict_kind = strategy_kind("predict", strategy)
+        for bucket in serving_cfg.support_buckets:
+            planned.update((adapt_kind, bucket, b) for b in batches)
+        for bucket in serving_cfg.query_buckets:
+            planned.update((predict_kind, bucket, b) for b in batches)
     return planned
 
 
 def train_planned_programs(cfg) -> Set[Tuple[str, ...]]:
     """The runner-side program family: train step (single and multi-dispatch)
     keyed by the (second_order, msl_active) static switches the config can
-    actually reach, plus the eval programs."""
+    actually reach, plus the eval programs — all under the configured
+    ``Config.strategy``'s kind spelling (bare legacy kinds for the default,
+    ``train@anil``-style otherwise, so per-strategy programs never share a
+    ledger/manifest/store identity)."""
+    from ..config import strategy_kind  # local: keep module deps one-way
+
+    strategy = getattr(cfg, "strategy", "maml++")
     # Over-planning is free (the planned set only REJECTS unplanned keys);
     # under-planning kills a healthy run. So: when a switch is off, only its
     # False variant is planned; when it is on, BOTH variants are — whatever
     # corner the annealing-window arithmetic (msl_active: epoch <
     # multi_step_loss_num_epochs; use_second_order: epoch >
     # first_order_to_second_order_epoch) lands in at runtime is covered.
-    so_values = {False} if not cfg.second_order else {True, False}
+    # fomaml pins the switch False for the whole run (MAMLSystem
+    # .use_second_order), so only the False variant is reachable.
+    so_values = (
+        {False}
+        if not cfg.second_order or strategy == "fomaml"
+        else {True, False}
+    )
     msl_values = (
         {False} if not cfg.use_multi_step_loss_optimization else {True, False}
     )
-    planned: Set[Tuple[str, ...]] = {("eval",), ("eval_multi",)}
+    planned: Set[Tuple[str, ...]] = {
+        (strategy_kind("eval", strategy),),
+        (strategy_kind("eval_multi", strategy),),
+    }
     for so in so_values:
         for msl in msl_values:
-            planned.add(("train", so, msl))
-            planned.add(("train_multi", so, msl))
+            planned.add((strategy_kind("train", strategy), so, msl))
+            planned.add((strategy_kind("train_multi", strategy), so, msl))
     return planned

@@ -403,6 +403,9 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
         padding = _padding_from_access(access_records)
         if padding is not None:
             report["padding"] = padding
+        strategies = _strategies_from_access(access_records)
+        if strategies is not None:
+            report["strategies"] = strategies
 
     xplane_dir = xplane_dir or _profile_dir_from_config(run_dir)
     breakdown = _device_breakdown(xplane_dir)
@@ -445,6 +448,39 @@ def _padding_from_access(records: List[Dict[str, Any]]) -> Optional[Dict[str, An
         "by_bucket": dict(sorted(per_bucket.items())),
         "padding_waste_frac": round(1.0 - total_true / total_padded, 4),
     }
+
+
+def _strategies_from_access(
+    records: List[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """Per-strategy latency/outcome table off access-log lines — the
+    post-hoc answer to "which adaptation tier ate the fleet, and at what
+    latency". Lines without a strategy field (HTTP-layer failures, synthetic
+    replica_death lines, pre-registry logs) are skipped; latency percentiles
+    use each line's ``total_ms`` where present."""
+    per: Dict[str, Dict[str, Any]] = {}
+    latencies: Dict[str, List[float]] = {}
+    for r in records:
+        strategy = r.get("strategy")
+        if not isinstance(strategy, str):
+            continue
+        row = per.setdefault(
+            strategy, {"requests": 0, "by_verb": {}, "by_outcome": {}}
+        )
+        row["requests"] += 1
+        verb, outcome = str(r.get("verb")), str(r.get("outcome"))
+        row["by_verb"][verb] = row["by_verb"].get(verb, 0) + 1
+        row["by_outcome"][outcome] = row["by_outcome"].get(outcome, 0) + 1
+        total_ms = r.get("total_ms")
+        if isinstance(total_ms, (int, float)):
+            latencies.setdefault(strategy, []).append(float(total_ms))
+    if not per:
+        return None
+    for strategy, vals in latencies.items():
+        vals.sort()
+        per[strategy]["p50_ms"] = round(vals[len(vals) // 2], 3)
+        per[strategy]["p95_ms"] = round(vals[min(len(vals) - 1, int(len(vals) * 0.95))], 3)
+    return dict(sorted(per.items()))
 
 
 def _fmt_mib(n: Optional[float]) -> str:
@@ -699,6 +735,22 @@ def render_human(report: Dict[str, Any]) -> str:
             lines.append(
                 f"{name[:20]:<20} {row['requests']:>8} {row['true_samples']:>8} "
                 f"{row['padded_samples']:>8} {row['waste_frac']:>7}"
+            )
+    strategies = report.get("strategies")
+    if strategies:
+        lines.append("-- serving strategies (access.jsonl) --")
+        lines.append(
+            f"{'strategy':<12} {'requests':>8} {'p50_ms':>8} {'p95_ms':>8} "
+            f"{'outcomes'}"
+        )
+        for name, row in strategies.items():
+            outcomes = ",".join(
+                f"{k}={v}" for k, v in sorted(row["by_outcome"].items())
+            )
+            lines.append(
+                f"{name[:12]:<12} {row['requests']:>8} "
+                f"{row.get('p50_ms', '-'):>8} {row.get('p95_ms', '-'):>8} "
+                f"{outcomes}"
             )
     hbm = report.get("hbm")
     if hbm:

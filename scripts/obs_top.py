@@ -140,6 +140,28 @@ def serving_frame(
         ),
         "_completed": completed,
     }
+    # live strategy mix (serving/server.py strategies block): per-tier
+    # request totals + the ok share — "which tier is eating the fleet" at
+    # a glance, with per-frame deltas against prev for the active mix
+    strategies = metrics.get("strategies")
+    if isinstance(strategies, dict) and strategies:
+        prev_mix = (prev or {}).get("_strategy_requests") or {}
+        mix = {}
+        for name, row in strategies.items():
+            if not isinstance(row, dict):
+                continue
+            total = row.get("requests", 0)
+            mix[name] = {
+                "requests": total,
+                "delta": max(0, total - prev_mix.get(name, 0)),
+                "ok": sum(
+                    v for k, v in row.items() if k.endswith(".ok")
+                ),
+            }
+        frame["strategy_mix"] = mix
+        frame["_strategy_requests"] = {
+            name: row["requests"] for name, row in mix.items()
+        }
     # fleet payloads (serving/pool.py): the router verdicts + one compact
     # row per replica — which failure domain is hot, dead, or tripping
     router = metrics.get("router")
@@ -303,6 +325,15 @@ def render(frame: Dict[str, Any]) -> str:
             f"hbm_headroom {_fmt(frame['hbm_headroom_frac'])}   "
             f"pad_waste {_fmt(frame.get('padding_waste_frac'))}"
         )
+        mix = frame.get("strategy_mix")
+        if mix:
+            total = sum(row["requests"] for row in mix.values()) or 1
+            parts = "  ".join(
+                f"{name} {row['requests']} "
+                f"({100 * row['requests'] // total}%, +{row['delta']})"
+                for name, row in sorted(mix.items())
+            )
+            lines.append(f"strategy {parts}")
         router = frame.get("router")
         if router:
             lines.append(

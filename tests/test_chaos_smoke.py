@@ -3,7 +3,7 @@
 The fast smoke runs a seeded in-process slice of the campaign — every
 invariant checked, subprocess episodes (rc=76 wedge, device-shrink) excluded
 for speed since tests/test_wedge_watchdog.py drills those bit-for-bit. The
-full soak (``-m slow``) runs ``scripts/chaos_soak.py --episodes 15 --seed 0``
+full soak (``-m slow``) runs ``scripts/chaos_soak.py --episodes 16 --seed 0``
 end to end and pins the one-JSON-line CLI contract."""
 
 import json
@@ -40,12 +40,12 @@ def test_episode_sampling_is_seeded_and_covers_every_seam():
         "checkpoint.write", "serving.dispatch", "serving.http",
     }
     # deterministic in seed; jittered across seeds
-    a = [e.kind for e in sample_episodes(7, 15)]
-    b = [e.kind for e in sample_episodes(7, 15)]
+    a = [e.kind for e in sample_episodes(7, 16)]
+    b = [e.kind for e in sample_episodes(7, 16)]
     assert a == b
-    assert len(sample_episodes(0, 15, include_subprocess=False)) == 15
+    assert len(sample_episodes(0, 16, include_subprocess=False)) == 16
     assert not any(
-        e.subprocess for e in sample_episodes(0, 15, include_subprocess=False)
+        e.subprocess for e in sample_episodes(0, 16, include_subprocess=False)
     )
 
 
@@ -73,7 +73,7 @@ def test_chaos_smoke_campaign_all_invariants_green(toy_dataset, tmp_path):
 
 @pytest.mark.slow
 def test_full_chaos_soak_cli(tmp_path):
-    """The acceptance command: ``python scripts/chaos_soak.py --episodes 15
+    """The acceptance command: ``python scripts/chaos_soak.py --episodes 16
     --seed 0`` (one full menu pass, including the ISSUE 6 grow-back /
     SIGTERM-during-async-save episodes, the ISSUE 11 replica-death episode,
     and the ISSUE 14 cross-process gateway drills) reports every invariant
@@ -81,7 +81,7 @@ def test_full_chaos_soak_cli(tmp_path):
     proc = subprocess.run(
         [
             sys.executable, "scripts/chaos_soak.py",
-            "--episodes", "15", "--seed", "0",
+            "--episodes", "16", "--seed", "0",
             "--work-dir", str(tmp_path),
         ],
         cwd=REPO,
@@ -94,7 +94,7 @@ def test_full_chaos_soak_cli(tmp_path):
     assert len(lines) == 1, lines
     verdict = json.loads(lines[0])
     assert verdict["ok"] is True
-    assert verdict["episodes"] == 15
+    assert verdict["episodes"] == 16
     assert verdict["violations"] == []
     kinds = {r["kind"] for r in verdict["episode_results"]}
     assert {

@@ -899,6 +899,44 @@ def test_self_gate_covers_program_memory_paths_explicitly():
     )
 
 
+def test_self_gate_covers_strategy_registry_paths_explicitly():
+    """The adaptation-strategy registry (ISSUE 15) sits inside the
+    self-gate on its own terms: strategies.py runs on the jitted hot path
+    (GL101/GL102/GL110 territory), and the touched serving paths thread
+    the per-request strategy through every dispatch seam — zero
+    unsuppressed findings even if the top-level path list is ever
+    restructured."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "core", "strategies.py"
+                ),
+                os.path.join("howtotrainyourmamlpytorch_tpu", "core", "maml.py"),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "engine.py"
+                ),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "server.py"
+                ),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "pool.py"
+                ),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "utils", "strictmode.py"
+                ),
+                os.path.join("howtotrainyourmamlpytorch_tpu", "compile", "aot.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in strategy-registry paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_catches_an_introduced_true_positive(tmp_path):
     """End-to-end: drop one fixture true positive next to real package code
     and the CLI must exit 1 with a GL id on stdout."""

@@ -1,0 +1,651 @@
+"""Adaptation-strategy registry (ISSUE 15, ``core/strategies.py``): the
+default path is jaxpr-pinned bit-identical, fomaml coincides with maml++
+under ``second_order=false`` by construction, ANIL's inner loop touches only
+the named head, protonet matches a NumPy reference, the serving engine
+round-trips every configured strategy over HTTP with cache isolation, the
+sealed guard sees zero outside-prewarm compiles across the whole strategy
+grid, and the speed claims hold on the toy."""
+
+import functools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from howtotrainyourmamlpytorch_tpu.config import (
+    Config,
+    ServingConfig,
+    kind_base,
+    kind_strategy,
+    load_config,
+    save_config,
+    strategy_kind,
+)
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.core.strategies import (
+    merge_head_body,
+    split_head_body,
+    take_head,
+    validate_request_strategy,
+)
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+from howtotrainyourmamlpytorch_tpu.serving import (
+    AdaptationEngine,
+    ServingFrontend,
+    UnknownAdaptationError,
+    make_http_server,
+)
+from howtotrainyourmamlpytorch_tpu.utils.strictmode import (
+    RecompileBudgetExceededError,
+    serving_planned_programs,
+    train_planned_programs,
+)
+
+_IMG = (14, 14, 1)
+
+
+def _config(**kw):
+    serving = kw.pop("serving", None)
+    base = dict(
+        num_classes_per_set=5,
+        num_samples_per_class=2,
+        num_target_samples=3,
+        batch_size=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        total_iter_per_epoch=4,
+    )
+    base.update(kw)
+    if serving is not None:
+        base["serving"] = serving
+    return Config(**base)
+
+
+def _system(cfg, filters: int = 4):
+    return MAMLSystem(
+        cfg,
+        model=build_vgg(
+            _IMG, cfg.num_classes_per_set, num_stages=2, cnn_num_filters=filters
+        ),
+    )
+
+
+def _batch(seed=0, tasks=2):
+    return {
+        k: np.asarray(v)
+        for k, v in synthetic_batch(tasks, 5, 2, 3, _IMG, seed=seed).items()
+    }
+
+
+def _support(seed=1):
+    epi = synthetic_batch(1, 5, 2, 3, _IMG, seed=seed)
+    return (
+        epi["x_support"][0],
+        epi["y_support"][0],
+        epi["x_target"][0].reshape((-1,) + _IMG),
+    )
+
+
+# ---------------------------------------------------------------------------
+# config + planned-set enumeration
+# ---------------------------------------------------------------------------
+
+
+def test_config_validation_and_kind_helpers():
+    with pytest.raises(ValueError, match="serving-only|forward-only"):
+        Config(strategy="protonet")
+    with pytest.raises(ValueError, match="strategy"):
+        Config(strategy="bogus")
+    with pytest.raises(ValueError, match="strategies"):
+        ServingConfig(strategies=["bogus"])
+    with pytest.raises(ValueError, match="at least one"):
+        ServingConfig(strategies=[])
+    # dedupe preserves order; the first entry is the default
+    assert ServingConfig(strategies=["anil", "maml++", "anil"]).strategies == [
+        "anil",
+        "maml++",
+    ]
+    # the default strategy keeps the bare legacy kind spelling
+    assert strategy_kind("train", "maml++") == "train"
+    assert strategy_kind("adapt", "protonet") == "adapt@protonet"
+    assert kind_base("train@anil") == "train"
+    assert kind_strategy("train@anil") == "anil"
+    assert kind_strategy("train") == "maml++"
+    with pytest.raises(ValueError, match="unknown strategy"):
+        validate_request_strategy("bogus", ("maml++",))
+    assert validate_request_strategy(None, ("anil", "maml++")) == "anil"
+
+
+def test_default_planned_sets_are_the_legacy_literals():
+    """The acceptance bar: a default config's planned sets (and with them
+    ledger rows, manifest program names, executable-store files) survive
+    the registry byte-identical."""
+    cfg = _config()
+    expected = {("eval",), ("eval_multi",)}
+    for so in (True, False):
+        for msl in (True, False):
+            expected.add(("train", so, msl))
+            expected.add(("train_multi", so, msl))
+    assert train_planned_programs(cfg) == expected
+    serving = ServingConfig(
+        support_buckets=[16], query_buckets=[16], max_batch_size=2
+    )
+    assert serving_planned_programs(serving) == {
+        ("adapt", 16, 1), ("adapt", 16, 2),
+        ("predict", 16, 1), ("predict", 16, 2),
+    }
+
+
+def test_strategy_planned_sets_enumerate_per_strategy():
+    anil = train_planned_programs(_config(strategy="anil"))
+    assert (("train@anil", True, True) in anil) and (("eval@anil",) in anil)
+    assert not any(k[0] == "train" for k in anil)
+    # fomaml pins second_order False: only the False variants are reachable
+    fomaml = train_planned_programs(_config(strategy="fomaml"))
+    assert ("train@fomaml", False, True) in fomaml
+    assert not any(len(k) == 3 and k[1] for k in fomaml)
+    serving = ServingConfig(
+        support_buckets=[16], query_buckets=[16], max_batch_size=2,
+        strategies=["maml++", "protonet"],
+    )
+    planned = serving_planned_programs(serving)
+    assert ("adapt", 16, 2) in planned and ("adapt@protonet", 16, 2) in planned
+    assert ("predict@protonet", 16, 1) in planned
+    assert len(planned) == 8
+
+
+def test_strategy_round_trips_through_yaml(tmp_path):
+    cfg = _config(
+        strategy="anil",
+        serving=ServingConfig(strategies=["anil", "protonet"]),
+    )
+    path = str(tmp_path / "config.yaml")
+    save_config(cfg, path)
+    loaded = load_config(path)
+    assert loaded.strategy == "anil"
+    assert loaded.serving.strategies == ["anil", "protonet"]
+
+
+# ---------------------------------------------------------------------------
+# default-path bit-identity + fomaml coincidence
+# ---------------------------------------------------------------------------
+
+
+def test_default_jaxpr_is_strategy_dispatch_free():
+    """``strategy="maml++"`` (and the strategy-less default) trace the
+    exact same train program: the registry dispatches host-side, so the
+    default jaxpr — and with it the persistent XLA cache — is untouched."""
+    s_default = _system(_config())
+    s_explicit = _system(_config(strategy="maml++"))
+    batch = _batch()
+    state = s_default.init_train_state()
+    j_default = jax.make_jaxpr(
+        functools.partial(
+            s_default._train_step_impl, second_order=True, msl_active=True
+        )
+    )(state, batch)
+    j_explicit = jax.make_jaxpr(
+        functools.partial(
+            s_explicit._train_step_impl, second_order=True, msl_active=True
+        )
+    )(s_explicit.init_train_state(), batch)
+    assert str(j_default) == str(j_explicit)
+    # ... and the ANIL program is genuinely different (sanity: the dispatch
+    # actually switches rollouts)
+    s_anil = _system(_config(strategy="anil"))
+    j_anil = jax.make_jaxpr(
+        functools.partial(
+            s_anil._train_step_impl, second_order=True, msl_active=True
+        )
+    )(s_anil.init_train_state(), batch)
+    assert str(j_anil) != str(j_default)
+
+
+def test_fomaml_coincides_with_second_order_false_by_construction():
+    """fomaml IS the existing rollout with the second-order switch pinned
+    False — same jaxpr, same one-step numbers, bitwise."""
+    s_fo = _system(_config(strategy="fomaml"))
+    s_so = _system(_config(second_order=False))
+    batch = _batch()
+    assert s_fo.use_second_order(epoch=50) is False
+    j_fo = jax.make_jaxpr(
+        functools.partial(
+            s_fo._train_step_impl, second_order=False, msl_active=True
+        )
+    )(s_fo.init_train_state(), batch)
+    j_so = jax.make_jaxpr(
+        functools.partial(
+            s_so._train_step_impl, second_order=False, msl_active=True
+        )
+    )(s_so.init_train_state(), batch)
+    assert str(j_fo) == str(j_so)
+    st_fo, out_fo = s_fo.train_step(s_fo.init_train_state(), batch, epoch=0)
+    st_so, out_so = s_so.train_step(s_so.init_train_state(), batch, epoch=0)
+    assert float(out_fo.loss) == float(out_so.loss)
+    np.testing.assert_array_equal(
+        np.asarray(out_fo.per_task_target_logits),
+        np.asarray(out_so.per_task_target_logits),
+    )
+
+
+# ---------------------------------------------------------------------------
+# ANIL: head/body partition + head-only inner loop
+# ---------------------------------------------------------------------------
+
+
+def test_head_body_partition_unit():
+    vgg_like = {"stage_0": {"conv": 1}, "stage_1": {"conv": 2}, "fc": {"w": 3}}
+    head, body = split_head_body(vgg_like)
+    assert set(head) == {"fc"} and set(body) == {"stage_0", "stage_1"}
+    assert merge_head_body(head, body) == vgg_like
+    # densenet names its head "classifier"
+    head2, _ = split_head_body({"block": 1, "classifier": {"w": 2}})
+    assert set(head2) == {"classifier"}
+    with pytest.raises(ValueError, match="no head"):
+        split_head_body({"stage_0": 1})
+    # derived trees (hparams / inner-optimizer state) slice at the
+    # parameter-shaped level; the SGD state's empty tuple passes through
+    hp = {"lr": {"stage_0": 0.1, "fc": 0.2}}
+    assert take_head(hp) == {"lr": {"fc": 0.2}}
+    adam_state = {
+        "step": {"stage_0": 0, "fc": 0},
+        "exp_avg": {"stage_0": 1, "fc": 2},
+    }
+    assert take_head(adam_state) == {"step": {"fc": 0}, "exp_avg": {"fc": 2}}
+    assert take_head(()) == ()
+
+
+def test_anil_inner_loop_touches_only_the_head():
+    cfg = _config(strategy="anil")
+    system = _system(cfg)
+    state = system.init_train_state()
+    x_s, y_s, _ = _support()
+    fw = system.adapt_fast_weights(
+        state, x_s.reshape((-1,) + _IMG), y_s.reshape(-1), strategy="anil"
+    )
+    for name, subtree in fw.items():
+        ref = state.params[name]
+        same = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(subtree), jax.tree.leaves(ref))
+        )
+        if name == "fc":
+            assert not same, "ANIL adapt left the head unchanged"
+        else:
+            assert same, f"ANIL adapt modified body subtree {name!r}"
+
+
+def test_anil_inner_grads_flow_only_through_the_head():
+    """The inner update's gradient tree IS the head tree: the scanned
+    meta-graph carries one linear layer, nothing of the conv stack."""
+    from howtotrainyourmamlpytorch_tpu.core.strategies import _anil_inner_update
+
+    cfg = _config(strategy="anil")
+    system = _system(cfg)
+    state = system.init_train_state()
+    x_s, y_s, _ = _support()
+    head, body = split_head_body(state.params)
+    update = _anil_inner_update(
+        system, body, state.bn_state,
+        jnp.asarray(x_s.reshape((-1,) + _IMG)),
+        jnp.asarray(y_s.reshape(-1)),
+        second_order=False,
+    )
+    hparams = system._inner_hparams_for_rollout(state.inner_hparams, state.params)
+    h_new, _ = update(head, take_head(()), take_head(hparams))
+    assert set(h_new) == {"fc"}
+    # the head moved, and the whole ANIL train step still produces
+    # meta-gradients for BOTH head and body (body through the forwards)
+    assert not np.array_equal(
+        np.asarray(h_new["fc"]["w"]), np.asarray(head["fc"]["w"])
+    )
+    batch = _batch()
+    st0 = system.init_train_state()
+    st1, out = system.train_step(st0, batch, epoch=0)
+    assert np.isfinite(float(out.loss))
+    for name in ("fc", "stage_0"):
+        moved = any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(
+                jax.tree.leaves(st1.params[name]),
+                jax.tree.leaves(st0.params[name]),
+            )
+        )
+        assert moved, f"outer step did not update {name!r} under ANIL"
+
+
+def test_anil_composes_with_msl_and_eval():
+    """The MSL annealing window (per-step target forwards) and the eval
+    program both run the head-only rollout without error."""
+    cfg = _config(
+        strategy="anil",
+        use_multi_step_loss_optimization=True,
+        multi_step_loss_num_epochs=5,
+    )
+    system = _system(cfg)
+    state = system.init_train_state()
+    batch = _batch()
+    assert system.msl_active(0)
+    state, out = system.train_step(state, batch, epoch=0)
+    assert np.isfinite(float(out.loss))
+    ev = system.eval_step(state, jax.tree.map(jnp.asarray, batch))
+    assert np.isfinite(float(ev.loss))
+
+
+# ---------------------------------------------------------------------------
+# protonet: NumPy reference parity + masking
+# ---------------------------------------------------------------------------
+
+
+def test_protonet_matches_numpy_reference():
+    cfg = _config(
+        serving=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=2,
+            strategies=["maml++", "protonet"],
+        )
+    )
+    system = _system(cfg)
+    engine = AdaptationEngine(system, system.init_train_state())
+    x_s, y_s, x_q = _support(seed=5)
+    fw = engine.adapt(x_s, y_s, strategy="protonet")
+    probs = engine.predict(fw, x_q, strategy="protonet")
+    # reference: embed through the network's f32 logit space, per-class
+    # means, negative squared euclidean distance, softmax — all in NumPy
+    flat_x = x_s.reshape((-1,) + _IMG)
+    flat_y = y_s.reshape(-1)
+    z_s = np.asarray(
+        system.predict_logits(engine.state.params, engine.state.bn_state, flat_x)
+    )
+    protos = np.stack([z_s[flat_y == k].mean(axis=0) for k in range(5)])
+    np.testing.assert_allclose(
+        np.asarray(fw["prototypes"]), protos, atol=1e-5
+    )
+    z_q = np.asarray(
+        system.predict_logits(engine.state.params, engine.state.bn_state, x_q)
+    )
+    d2 = ((z_q[:, None, :] - protos[None]) ** 2).sum(-1)
+    e = np.exp(-d2 - (-d2).max(axis=-1, keepdims=True))
+    ref = e / e.sum(axis=-1, keepdims=True)
+    np.testing.assert_allclose(probs, ref, atol=1e-5)
+
+
+def test_protonet_bucket_padding_is_prediction_invariant():
+    """Support 10 padded to a 16-bucket must produce the same prototypes
+    (and probs) as the exact-shape program — the masked-prototype +
+    masked-BN contract, same bar the gradient strategies meet."""
+    cfg_exact = _config(
+        serving=ServingConfig(
+            support_buckets=[10], query_buckets=[15], strategies=["protonet"]
+        )
+    )
+    system = _system(cfg_exact)
+    state = system.init_train_state()
+    exact = AdaptationEngine(system, state)
+    padded = AdaptationEngine(
+        system, state,
+        serving_cfg=ServingConfig(
+            support_buckets=[16], query_buckets=[32], strategies=["protonet"]
+        ),
+    )
+    x_s, y_s, x_q = _support(seed=9)
+    p_exact = exact.predict(exact.adapt(x_s, y_s), x_q)
+    p_padded = padded.predict(padded.adapt(x_s, y_s), x_q)
+    np.testing.assert_allclose(p_exact, p_padded, atol=1e-5)
+
+
+def test_protonet_rejected_as_train_strategy_and_fast_weight_rollout():
+    with pytest.raises(ValueError):
+        Config(strategy="protonet")
+    system = _system(_config())
+    with pytest.raises(ValueError, match="protonet"):
+        system.adapt_fast_weights(
+            system.init_train_state(),
+            np.zeros((10,) + _IMG, np.float32),
+            np.zeros(10, np.int32),
+            strategy="protonet",
+        )
+
+
+# ---------------------------------------------------------------------------
+# engine + frontend: per-strategy round trip, isolation, HTTP contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def menu_frontend(tmp_path_factory):
+    cfg = _config(
+        serving=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=2,
+            strategies=["maml++", "protonet", "anil"],
+        )
+    )
+    system = _system(cfg)
+    engine = AdaptationEngine(system, system.init_train_state())
+    access_dir = str(tmp_path_factory.mktemp("access"))
+    frontend = ServingFrontend(engine, access_log_dir=access_dir)
+    server = make_http_server(frontend, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield frontend, f"http://127.0.0.1:{server.server_address[1]}", access_dir
+    server.shutdown()
+    server.server_close()
+    frontend.close()
+    thread.join(timeout=5)
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_http_round_trip_per_strategy_with_cache_isolation(menu_frontend):
+    frontend, base, access_dir = menu_frontend
+    x_s, y_s, x_q = _support(seed=13)
+    payload = {"x_support": x_s.tolist(), "y_support": y_s.tolist()}
+    ids = {}
+    for strategy in ("maml++", "protonet", "anil"):
+        status, out = _post(base, "/adapt", {**payload, "strategy": strategy})
+        assert status == 200 and out["strategy"] == strategy
+        assert out["cached"] is False
+        ids[strategy] = out["adaptation_id"]
+        status, again = _post(base, "/adapt", {**payload, "strategy": strategy})
+        assert again["cached"] is True, f"{strategy} repeat adapt missed"
+        status, pred = _post(
+            base, "/predict",
+            {"adaptation_id": ids[strategy], "x_query": x_q.tolist(),
+             "strategy": strategy},
+        )
+        assert status == 200 and len(pred["probs"]) == x_q.shape[0]
+    # one support set, three strategies, three DISTINCT sessions
+    assert len(set(ids.values())) == 3
+    # wrong-strategy predict = honest 404, never a cross-strategy result
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(
+            base, "/predict",
+            {"adaptation_id": ids["protonet"], "x_query": x_q.tolist()},
+        )
+    assert err.value.code == 404
+    # unknown strategy = 400 with an access-resolvable request id
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(base, "/adapt", {**payload, "strategy": "nope"})
+    assert err.value.code == 400
+    rid = err.value.headers.get("X-Request-Id")
+    assert rid
+    # /metrics carries the per-strategy mix + padding breakdown
+    with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
+        metrics = json.loads(resp.read())
+    mix = metrics["strategies"]
+    for strategy in ("maml++", "protonet", "anil"):
+        assert mix[strategy]["adapt.ok"] >= 1
+        assert mix[strategy]["predict.ok"] >= 1
+    assert metrics["compiled"]["strategies"] == ["maml++", "protonet", "anil"]
+    assert set(metrics["padding"]["by_strategy"]) >= {"maml++", "protonet"}
+    # access lines carry the strategy (the 400 and 404 included — non-ok
+    # outcomes bypass sampling by contract)
+    from howtotrainyourmamlpytorch_tpu.observability.context import (
+        read_access_log,
+    )
+
+    records, torn = read_access_log(access_dir + "/access.jsonl")
+    assert torn == 0
+    by_strategy = {r.get("strategy") for r in records}
+    assert by_strategy >= {"maml++", "protonet", "anil"}
+    assert rid in {r.get("trace_id") for r in records}
+
+
+def test_in_process_strategy_menu_defaults_and_validation(menu_frontend):
+    frontend, _, _ = menu_frontend
+    x_s, y_s, x_q = _support(seed=17)
+    # None = the first configured entry (maml++ here)
+    out = frontend.adapt(x_s, y_s)
+    assert out["strategy"] == "maml++"
+    with pytest.raises(ValueError, match="unknown strategy"):
+        frontend.adapt(x_s, y_s, strategy="bogus")
+    # cross-strategy predict in-process: same honest 404 class
+    info = frontend.adapt(x_s, y_s, strategy="anil")
+    with pytest.raises(UnknownAdaptationError):
+        frontend.predict(info["adaptation_id"], x_q, strategy="protonet")
+
+
+def test_obs_report_strategy_table_from_access_log(menu_frontend):
+    frontend, base, access_dir = menu_frontend
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report_mod",
+        os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                     "scripts", "obs_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    records, _ = __import__(
+        "howtotrainyourmamlpytorch_tpu.observability.context",
+        fromlist=["read_access_log"],
+    ).read_access_log(access_dir + "/access.jsonl")
+    table = mod._strategies_from_access(records)
+    assert table is not None and set(table) >= {"maml++", "protonet"}
+    for row in table.values():
+        assert row["requests"] >= 1 and "by_outcome" in row
+        assert "p50_ms" in row
+
+
+# ---------------------------------------------------------------------------
+# sealed-guard prewarm over the strategy grid + session spill
+# ---------------------------------------------------------------------------
+
+
+def test_sealed_guard_prewarm_covers_the_strategy_grid():
+    cfg = _config(
+        strict_recompile_guard=True,
+        serving=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=2,
+            strategies=["maml++", "protonet", "anil"],
+        ),
+    )
+    system = _system(cfg)
+    engine = AdaptationEngine(system, system.init_train_state())
+    summary = engine.prewarm(max_workers=1)
+    assert summary["errors"] == 0
+    assert summary["programs"] == len(serving_planned_programs(cfg.serving))
+    sealed = engine.recompile_guard.snapshot()
+    assert sealed["prewarmed"]
+    x_s, y_s, x_q = _support(seed=23)
+    for strategy in ("maml++", "protonet", "anil"):
+        fw = engine.adapt(x_s, y_s, strategy=strategy)
+        engine.predict(fw, x_q, strategy=strategy)
+    snap = engine.recompile_guard.snapshot()
+    assert snap["violations"] == []
+    assert snap["lowerings"] == sealed["lowerings"], (
+        "mixed-strategy traffic compiled outside the prewarmed grid"
+    )
+    # a valid-but-unconfigured strategy is an unplanned program: strict
+    # mode rejects it instead of silently compiling
+    with pytest.raises(RecompileBudgetExceededError):
+        engine.adapt(x_s, y_s, strategy="fomaml")
+
+
+def test_session_store_round_trips_strategy(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.serving.sessions import SessionStore
+
+    store = SessionStore(str(tmp_path / "sessions"))
+    tree = {"fc": {"w": np.ones((3, 2), np.float32)}}
+    store.spill("d1", tree, "fp", age_s=1.0, ttl_s=600.0, strategy="anil")
+    entries, stats = store.load_all(fingerprint="fp", template=tree)
+    assert stats["loaded"] == 1
+    digest, loaded, lived_s, strategy = entries[0]
+    assert digest == "d1" and strategy == "anil"
+    np.testing.assert_array_equal(loaded["fc"]["w"], tree["fc"]["w"])
+
+
+# ---------------------------------------------------------------------------
+# the measured-speedup smoke
+# ---------------------------------------------------------------------------
+
+
+def test_strategy_speedups_on_the_toy():
+    """The registry's reason to exist, asserted with generous margins
+    (measured ~8x train and ~0.2x adapt on this shape): an ANIL train step
+    beats a maml++ train step, and a protonet adapt dispatch beats a
+    maml++ adapt dispatch."""
+
+    def median_step(strategy):
+        cfg = _config(strategy=strategy, number_of_training_steps_per_iter=3)
+        system = _system(cfg, filters=8)
+        state = system.init_train_state()
+        batch = _batch(seed=2)
+        state, out = system.train_step(state, batch, epoch=0)
+        out.loss.block_until_ready()
+        reps = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            state, out = system.train_step(state, batch, epoch=0)
+            out.loss.block_until_ready()
+            reps.append(time.perf_counter() - t0)
+        return sorted(reps)[len(reps) // 2]
+
+    t_maml = median_step("maml++")
+    t_anil = median_step("anil")
+    assert t_anil < t_maml, (
+        f"ANIL train step ({t_anil * 1e3:.1f} ms) is not faster than "
+        f"maml++ ({t_maml * 1e3:.1f} ms)"
+    )
+
+    cfg = _config(
+        number_of_evaluation_steps_per_iter=3,
+        serving=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=2,
+            strategies=["maml++", "protonet"],
+        ),
+    )
+    system = _system(cfg, filters=8)
+    engine = AdaptationEngine(system, system.init_train_state())
+    x_s, y_s, _ = _support(seed=3)
+    times = {}
+    for strategy in ("maml++", "protonet"):
+        fw = engine.adapt(x_s, y_s, strategy=strategy)
+        jax.block_until_ready(fw)
+        reps = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            fw = engine.adapt(x_s, y_s, strategy=strategy)
+            jax.block_until_ready(fw)
+            reps.append(time.perf_counter() - t0)
+        times[strategy] = sorted(reps)[len(reps) // 2]
+    assert times["protonet"] < times["maml++"], (
+        f"protonet adapt ({times['protonet'] * 1e3:.2f} ms) is not faster "
+        f"than maml++ adapt ({times['maml++'] * 1e3:.2f} ms)"
+    )
