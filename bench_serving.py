@@ -165,6 +165,22 @@ def main(argv=None) -> int:
         )
         return 2
     strategy = strategy_knob or "maml++"
+    # BENCH_TENANTS, same rc-2 contract: N > 0 spreads the SLO staircase
+    # across N synthetic tenants (perturbed checkpoints behind an
+    # in-process registry), measuring the weight pager in the same line.
+    # "" / 0 = the single-tenant recipe exactly as before.
+    tenants_knob = os.environ.get("BENCH_TENANTS", "")
+    try:
+        n_tenants = int(tenants_knob) if tenants_knob else 0
+    except ValueError:
+        n_tenants = -1
+    if n_tenants < 0:
+        print(
+            f"bench_serving: bad BENCH_TENANTS {tenants_knob!r} "
+            "(want a non-negative integer)",
+            file=sys.stderr,
+        )
+        return 2
     cfg = Config(
         num_classes_per_set=args.n_way,
         num_samples_per_class=args.k_shot,
@@ -193,8 +209,21 @@ def main(argv=None) -> int:
     )
 
     ledger = CompileLedger()
+    state = system.init_train_state()
+    registry = None
+    if n_tenants:
+        import tempfile
+
+        from howtotrainyourmamlpytorch_tpu.serving.registry import (
+            synthetic_registry,
+        )
+
+        registry = synthetic_registry(
+            [f"t{i}" for i in range(n_tenants)], state,
+            tempfile.mkdtemp(prefix="bench_tenants_"),
+        )
     engine = AdaptationEngine(
-        system, system.init_train_state(), compile_ledger=ledger
+        system, state, compile_ledger=ledger, registry=registry
     )
 
     def episode(seed):
@@ -346,6 +375,7 @@ def main(argv=None) -> int:
             schedule = slo.generate_schedule(
                 0, slo_duration, stairs,
                 adapt_frac=0.25, query_sizes=(args.n_query,), query_weights=(1.0,),
+                tenants=[f"t{i}" for i in range(n_tenants)] or None,
             )
             if schedule:
                 run = slo.run_load(
@@ -363,6 +393,16 @@ def main(argv=None) -> int:
                 result["slo_breaker_trips"] = slo_rep["breaker_trips"]
                 if "per_replica" in slo_rep:
                     result["per_replica"] = slo_rep["per_replica"]
+        # multi-tenant paging story (BENCH_TENANTS arm); the fields stay in
+        # the line either way so single- and multi-tenant captures join
+        result["tenants"] = n_tenants
+        pager_stats = frontend.pool.pager_stats()
+        result["page_in_p50_ms"] = (
+            pager_stats["page_in_p50_ms"] if pager_stats else None
+        )
+        result["tenant_evictions"] = (
+            pager_stats["evictions"] if pager_stats else None
+        )
     finally:
         frontend.close()
     device_kind = str(jax.devices()[0].device_kind)

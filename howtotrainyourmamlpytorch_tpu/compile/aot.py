@@ -456,6 +456,15 @@ def prewarm_serving(
     params = engine.state.params
     plan = serving_planned_programs(engine.serving)
     fw_specs: Dict[Any, Any] = {}
+    # tenant mode (serving/tenancy.py): the engine's programs take the
+    # master state as their first argument — the prewarm specs gain it, and
+    # the ONE compiled executable per (kind, bucket, batch) then serves
+    # every tenant (a cold tenant costs a page-in, never a compile)
+    state_specs = (
+        (shape_specs(engine.state),)
+        if getattr(engine, "pager", None) is not None
+        else ()
+    )
     jobs: List[Tuple[str, Callable, Sequence[Any]]] = []
     for key in sorted(plan, key=repr):
         kind, bucket, b = key
@@ -465,7 +474,7 @@ def prewarm_serving(
         tag = getattr(engine, "ledger_tag", "")
         if base == "adapt":
             fn = engine._compiled_adapt(bucket, b, strategy=strategy)
-            args = (
+            args = state_specs + (
                 _sds((b, bucket, h, w, c), np.float32),
                 _sds((b, bucket), np.int32),
                 _sds((b, bucket), np.float32),
@@ -490,7 +499,7 @@ def prewarm_serving(
                     }
                 else:
                     fw_specs[spec_key] = shape_specs(params, leading=(b,))
-            args = (
+            args = state_specs + (
                 fw_specs[spec_key],
                 _sds((b, bucket, h, w, c), np.float32),
                 _sds((b, bucket), np.float32),

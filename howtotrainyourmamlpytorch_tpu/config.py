@@ -73,6 +73,13 @@ TRAIN_STRATEGIES = ("maml++", "fomaml", "anil")
 SERVING_STRATEGIES = ("maml++", "fomaml", "anil", "protonet")
 DEFAULT_STRATEGY = "maml++"
 
+# Multi-tenant serving (serving/registry.py + serving/tenancy.py): the
+# default tenant is the frontend's own restored checkpoint. Requests that
+# omit the tenant field — and requests naming it explicitly — resolve to
+# the same internal identity (None), so adaptation ids, cache keys, and
+# session files are byte-identical to the pre-tenancy platform.
+DEFAULT_TENANT = "default"
+
 
 def strategy_kind(kind: str, strategy: str) -> str:
     """Program-key kind with the strategy component attached. The default
@@ -209,6 +216,30 @@ class ServingConfig:
     # Only active for run-dir engines (an engine with no run dir has
     # nowhere durable to spill).
     session_spill: bool = True
+    # Multi-tenant registry (serving/registry.py + serving/tenancy.py):
+    # path to a tenants.yaml mapping tenant ids to checkpoint run dirs.
+    # None (default) = single-tenant mode, byte-identical to the
+    # pre-tenancy engine (closed-over-state programs, unchanged digests/
+    # planned sets); a run-dir engine also auto-detects
+    # <run_dir>/tenants.yaml. With a registry the engine compiles
+    # state-as-ARGUMENT programs under the SAME shape-keyed program set,
+    # so every tenant shares the prewarmed executables — a cold tenant
+    # costs one host->device page-in, never an XLA compile.
+    tenant_registry: Optional[str] = None
+    # WeightPager HBM byte budget for tenant master states resident on
+    # device (the default tenant's state is pinned and NOT counted).
+    # 0 = unbounded (eviction still fires on the watermark signal below).
+    tenant_budget_bytes: int = 0
+    # Evict the LRU tenant when the HBM watermark provider
+    # (observability/memory.py) reports min headroom below this fraction;
+    # 0 disables the watermark trigger (byte budget only).
+    tenant_min_headroom_frac: float = 0.0
+    # Per-tenant quotas (serving/tenancy.py::TenantQuotas), enforced at
+    # admission with the router's shed contract (429 + honest
+    # Retry-After). 0 disables the respective quota.
+    tenant_max_inflight: int = 0
+    tenant_rate_rps: float = 0.0
+    tenant_max_resident_bytes: int = 0
 
     def __post_init__(self):
         self.support_buckets = sorted(int(b) for b in self.support_buckets)
@@ -248,6 +279,31 @@ class ServingConfig:
         if self.drain_deadline_s <= 0:
             raise ValueError(
                 f"drain_deadline_s must be > 0, got {self.drain_deadline_s}"
+            )
+        if self.tenant_budget_bytes < 0:
+            raise ValueError(
+                f"tenant_budget_bytes must be >= 0 (0 = unbounded), "
+                f"got {self.tenant_budget_bytes}"
+            )
+        if not 0.0 <= self.tenant_min_headroom_frac < 1.0:
+            raise ValueError(
+                "tenant_min_headroom_frac must be in [0, 1) (0 = disabled), "
+                f"got {self.tenant_min_headroom_frac}"
+            )
+        if self.tenant_max_inflight < 0:
+            raise ValueError(
+                f"tenant_max_inflight must be >= 0 (0 = disabled), "
+                f"got {self.tenant_max_inflight}"
+            )
+        if self.tenant_rate_rps < 0:
+            raise ValueError(
+                f"tenant_rate_rps must be >= 0 (0 = disabled), "
+                f"got {self.tenant_rate_rps}"
+            )
+        if self.tenant_max_resident_bytes < 0:
+            raise ValueError(
+                f"tenant_max_resident_bytes must be >= 0 (0 = disabled), "
+                f"got {self.tenant_max_resident_bytes}"
             )
 
 

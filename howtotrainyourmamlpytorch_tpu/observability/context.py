@@ -71,6 +71,7 @@ class RequestContext:
     bucket: Any = None  # shape bucket the frontend routed to
     true_size: Optional[int] = None  # pre-padding sample count (waste acct)
     strategy: Optional[str] = None  # adaptation strategy the request named
+    tenant: Optional[str] = None  # tenant the request named (None = default)
     replica: Optional[int] = None  # pool replica the router chose
     flush_batch: Optional[int] = None  # requests sharing the flush
     queue_wait_s: Optional[float] = None  # submit -> worker pickup
@@ -217,6 +218,7 @@ class AccessLog:
             "bucket": ctx.bucket,
             "true_size": ctx.true_size,
             "strategy": ctx.strategy,
+            "tenant": ctx.tenant,
             "replica": ctx.replica,
             "flush_batch": ctx.flush_batch,
             "cache_hit": ctx.cache_hit,

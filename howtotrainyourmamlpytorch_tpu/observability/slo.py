@@ -58,6 +58,8 @@ class Request:
     episode_seed: int
     n_query: int
     stair: int  # index into the offered-load staircase
+    # None = the default tenant (single-tenant schedules stay byte-identical)
+    tenant: Optional[str] = None
 
 
 def generate_schedule(
@@ -68,19 +70,37 @@ def generate_schedule(
     query_sizes: Sequence[int] = (5, 15, 40),
     query_weights: Sequence[float] = (0.7, 0.2, 0.1),
     tail_sigma: float = DEFAULT_TAIL_SIGMA,
+    tenants: Optional[Sequence[str]] = None,
+    tenant_weights: Optional[Sequence[float]] = None,
 ) -> List[Request]:
     """Deterministic open-loop schedule: ``duration_s`` split evenly across
     ``stairs_rps`` offered-load stages; within a stage, inter-arrivals are
     lognormal with mean ``1/rps`` (heavy-tailed: sigma in log space), kinds
     drawn ``adapt`` with probability ``adapt_frac``, query sizes skewed by
     ``query_weights`` (the bucket-skew knob: most traffic hits the small
-    buckets, a tail hits the big ones)."""
+    buckets, a tail hits the big ones). With ``tenants``, each request
+    additionally draws a tenant id, skewed by ``tenant_weights`` (uniform
+    when None); without, no extra RNG draws happen, so pre-tenancy seeds
+    keep bit-identical schedules."""
     if not stairs_rps:
         raise ValueError("stairs_rps must name at least one offered load")
     if duration_s <= 0:
         raise ValueError(f"duration_s must be > 0, got {duration_s}")
     weights = np.asarray(query_weights, np.float64)
     weights = weights / weights.sum()
+    t_weights = None
+    if tenants:
+        t_weights = (
+            np.asarray(tenant_weights, np.float64)
+            if tenant_weights is not None
+            else np.ones(len(tenants), np.float64)
+        )
+        if len(t_weights) != len(tenants):
+            raise ValueError(
+                f"tenant_weights names {len(t_weights)} weights for "
+                f"{len(tenants)} tenants"
+            )
+        t_weights = t_weights / t_weights.sum()
     rng = np.random.default_rng(int(seed))
     per_stair = float(duration_s) / len(stairs_rps)
     schedule: List[Request] = []
@@ -102,6 +122,11 @@ def generate_schedule(
                     episode_seed=int(rng.integers(0, 2**31)),
                     n_query=int(query_sizes[int(rng.choice(len(weights), p=weights))]),
                     stair=stair,
+                    tenant=(
+                        str(tenants[int(rng.choice(len(t_weights), p=t_weights))])
+                        if t_weights is not None
+                        else None
+                    ),
                 )
             )
     return schedule
@@ -122,6 +147,18 @@ def schedule_digest(schedule: List[Request]) -> Dict[str, Any]:
         ],
         "first_t": schedule[0].t if schedule else None,
         "last_t": schedule[-1].t if schedule else None,
+        # only multi-tenant schedules grow the extra key: single-tenant
+        # digests stay byte-identical to pre-tenancy ones
+        **(
+            {
+                "per_tenant": {
+                    t: sum(1 for r in schedule if r.tenant == t)
+                    for t in sorted({r.tenant for r in schedule if r.tenant})
+                }
+            }
+            if any(r.tenant for r in schedule)
+            else {}
+        ),
     }
 
 
@@ -224,25 +261,23 @@ class HttpFrontend:
             self._note(None, "error")
             raise RuntimeError(f"{path}: {exc.reason}") from exc
 
-    def adapt(self, x_support, y_support, ctx=None) -> Dict[str, Any]:
-        return self._post(
-            "/adapt",
-            {
-                "x_support": np.asarray(x_support, np.float32).tolist(),
-                "y_support": np.asarray(y_support, np.int32).tolist(),
-            },
-            ctx,
-        )
+    def adapt(self, x_support, y_support, ctx=None, tenant=None) -> Dict[str, Any]:
+        payload = {
+            "x_support": np.asarray(x_support, np.float32).tolist(),
+            "y_support": np.asarray(y_support, np.int32).tolist(),
+        }
+        if tenant is not None:
+            payload["tenant"] = tenant
+        return self._post("/adapt", payload, ctx)
 
-    def predict(self, adaptation_id: str, x_query, ctx=None) -> np.ndarray:
-        out = self._post(
-            "/predict",
-            {
-                "adaptation_id": adaptation_id,
-                "x_query": np.asarray(x_query, np.float32).tolist(),
-            },
-            ctx,
-        )
+    def predict(self, adaptation_id: str, x_query, ctx=None, tenant=None) -> np.ndarray:
+        payload = {
+            "adaptation_id": adaptation_id,
+            "x_query": np.asarray(x_query, np.float32).tolist(),
+        }
+        if tenant is not None:
+            payload["tenant"] = tenant
+        out = self._post("/predict", payload, ctx)
         return np.asarray(out["probs"], np.float32)
 
     def per_backend(self) -> Dict[str, Dict[str, int]]:
@@ -353,7 +388,10 @@ def run_load(
     if not schedule:
         raise ValueError("schedule is empty — lengthen duration_s or raise stairs_rps")
     results = _Results()
-    ids: List[str] = []
+    # adaptation-id pools are PER TENANT (None = default): an adaptation id
+    # carries its tenant's checkpoint fingerprint, so a predict naming a
+    # different tenant's id is an honest 404, never load-test traffic
+    ids: Dict[Optional[str], List[str]] = {None: []}
     ids_lock = threading.Lock()
 
     # -- warmup: compile + seed the adaptation pool (excluded). One predict
@@ -363,11 +401,24 @@ def run_load(
         x_s, y_s = make_support(-(i + 1))
         info = frontend.adapt(x_s, y_s)
         with ids_lock:
-            ids.append(info["adaptation_id"])
+            ids[None].append(info["adaptation_id"])
     for n_query in sorted({r.n_query for r in schedule}):
-        frontend.predict(ids[0], make_query(-1, n_query))
+        frontend.predict(ids[None][0], make_query(-1, n_query))
+    # one warm adapt per scheduled tenant: seeds each tenant's id pool so
+    # every scheduled predict has a same-tenant adaptation to resolve
+    # (pages the tenant in, which is exactly one host->device transfer —
+    # page-in thrash mid-test still shows up, the budget decides residency)
+    for j, tenant in enumerate(sorted({r.tenant for r in schedule if r.tenant})):
+        x_s, y_s = make_support(-1001 - j)
+        info = frontend.adapt(x_s, y_s, tenant=tenant)
+        with ids_lock:
+            ids.setdefault(tenant, []).append(info["adaptation_id"])
     _warm_batch_buckets(frontend, schedule, make_support, make_query, log)
-    log(f"loadgen: warm ({len(ids)} adaptations cached)")
+    log(
+        "loadgen: warm "
+        f"({sum(len(v) for v in ids.values())} adaptations cached, "
+        f"{len(ids) - 1} tenant(s))"
+    )
     breaker_before = frontend.breaker.snapshot()
     opens_before = _breaker_opens_total(frontend, breaker_before)
 
@@ -390,24 +441,28 @@ def run_load(
 
     def one(req: Request, sched_t: float) -> None:
         ctx = new_request_context()
+        # the tenant kwarg only appears on multi-tenant requests: doubles
+        # without the parameter keep working for single-tenant schedules
+        tenant_kw = {"tenant": req.tenant} if req.tenant else {}
         try:
             if req.kind == "adapt":
                 x_s, y_s = make_support(req.episode_seed)
                 if adapt_takes_ctx:
-                    info = frontend.adapt(x_s, y_s, ctx=ctx)
+                    info = frontend.adapt(x_s, y_s, ctx=ctx, **tenant_kw)
                 else:
-                    info = frontend.adapt(x_s, y_s)
+                    info = frontend.adapt(x_s, y_s, **tenant_kw)
                 with ids_lock:
-                    ids.append(info["adaptation_id"])
+                    ids.setdefault(req.tenant, []).append(info["adaptation_id"])
                 outcome = "ok"
             else:
                 with ids_lock:
-                    aid = ids[req.episode_seed % len(ids)]
+                    pool_ids = ids[req.tenant]
+                    aid = pool_ids[req.episode_seed % len(pool_ids)]
                 query = make_query(req.episode_seed, req.n_query)
                 if predict_takes_ctx:
-                    frontend.predict(aid, query, ctx=ctx)
+                    frontend.predict(aid, query, ctx=ctx, **tenant_kw)
                 else:
-                    frontend.predict(aid, query)
+                    frontend.predict(aid, query, **tenant_kw)
                 outcome = "ok"
         except ServiceUnavailableError:
             outcome = "shed"

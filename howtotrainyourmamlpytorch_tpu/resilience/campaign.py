@@ -256,6 +256,13 @@ def episode_menu(rng: np.random.RandomState) -> List[Episode]:
         # the whole mixed stream, unknown strategy = 400 over the wire,
         # and every non-200 resolvable to an access line
         Episode(kind="serve-strategy-mix", mode="serve"),
+        # 4 tenants thrashing a weight-pager budget that fits only 2:
+        # per-tenant responses stay bit-identical to single-tenant control
+        # engines, every eviction is a logged event, the sealed guard sees
+        # ZERO outside-prewarm compiles (paging is a transfer, never a
+        # compile), tenant A's adaptation id from tenant B is an honest
+        # 404, and every non-200 resolves to an access line
+        Episode(kind="serve-tenant-thrash", mode="serve"),
         # --- cross-process fleet drills (ISSUE 14): a REAL gateway process
         # (scripts/gateway.py) in front of REAL serve backends (subprocess
         # interpreters running the actual run_server drain path). Marked
@@ -845,6 +852,197 @@ def _run_serve_episode(ep: Episode) -> List[str]:
                 f"access lines do not carry both strategies: "
                 f"{sorted(strategies_logged)}"
             )
+    elif ep.kind == "serve-tenant-thrash":
+        # M=4 tenants behind ONE strict-mode frontend, paged under a byte
+        # budget sized to fit only M/2 of their masters. Invariants:
+        # (1) determinism under thrash — every tenant's probs over the wire
+        # are bit-identical to a single-tenant control engine built from
+        # that tenant's checkpoint alone, including after its master was
+        # evicted and paged back in; (2) the sealed recompile guard sees
+        # ZERO outside-prewarm compiles across the whole thrash (a cold
+        # tenant costs one host->device transfer, never an XLA compile);
+        # (3) evictions happen and are logged (events.jsonl + /metrics);
+        # (4) cross-tenant isolation — tenant A's adaptation id predicted
+        # as tenant B is an honest 404, unknown tenant is a 400, and every
+        # non-200 resolves to an access-log line.
+        import dataclasses
+        import tempfile
+        import urllib.error
+        import urllib.request
+
+        from ..experiment import checkpoint as _ckpt
+        from ..observability.context import read_access_log
+        from ..serving.registry import synthetic_registry
+
+        tenant_ids = [f"t{i}" for i in range(4)]
+        thrash_cfg = dataclasses.replace(
+            cfg,
+            strict_recompile_guard=True,
+            serving=ServingConfig(
+                support_buckets=[16], query_buckets=[16], max_batch_size=2,
+            ),
+        )
+        thrash_system = MAMLSystem(
+            thrash_cfg,
+            model=build_vgg(img, 5, num_stages=2, cnn_num_filters=4),
+        )
+        state = thrash_system.init_train_state()
+        reg_root = tempfile.mkdtemp(prefix="chaos_tenants_")
+        registry = synthetic_registry(tenant_ids, state, reg_root, seed=7)
+        engine = AdaptationEngine(thrash_system, state, registry=registry)
+        warm = engine.prewarm(max_workers=1)
+        if warm["errors"]:
+            violations.append(f"tenant-grid prewarm errors: {warm}")
+        # single-tenant CONTROL probs: one engine per tenant, built from
+        # that tenant's checkpoint alone (no registry, no pager)
+        epi3 = synthetic_batch(1, 5, 2, 3, img, seed=31)
+        x_s, y_s = epi3["x_support"][0], epi3["y_support"][0]
+        x_q = epi3["x_target"][0].reshape((-1,) + img)
+        control_probs = {}
+        for tenant in tenant_ids:
+            inf, _ = _ckpt.load_for_inference(
+                os.path.join(reg_root, tenant, "saved_models"), "latest"
+            )
+            ctrl = AdaptationEngine(thrash_system, inf)
+            control_probs[tenant] = np.asarray(
+                ctrl.predict(ctrl.adapt(x_s, y_s), x_q)
+            )
+        access_dir = tempfile.mkdtemp(prefix="chaos_access_")
+        frontend = ServingFrontend(engine, access_log_dir=access_dir)
+        server = make_http_server(frontend, "127.0.0.1", 0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        non_200_ids = []
+
+        def _post(path, body, timeout=60):
+            req = urllib.request.Request(
+                base + path,
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+
+        try:
+            payload = {"x_support": x_s.tolist(), "y_support": y_s.tolist()}
+            # size the budget off the first page-in: it must fit M/2
+            # masters, so 4 tenants round-robin = guaranteed thrash
+            _post("/adapt", {**payload, "tenant": tenant_ids[0]})
+            per_tenant = engine.pager.stats()["resident_bytes"]
+            if per_tenant <= 0:
+                violations.append("pager reports zero resident bytes after a page-in")
+            engine.pager.budget_bytes = 2 * per_tenant
+            ids = {}
+            # two thrash rounds: round 2 re-serves tenants already evicted
+            # in round 1, so 'evict then page back in' determinism is
+            # exercised for real, not just first-touch paging
+            for _ in range(2):
+                for tenant in tenant_ids:
+                    _, out = _post("/adapt", {**payload, "tenant": tenant})
+                    ids[tenant] = out["adaptation_id"]
+                    _, probs = _post(
+                        "/predict",
+                        {"adaptation_id": ids[tenant],
+                         "x_query": x_q.tolist(), "tenant": tenant},
+                    )
+                    if not np.array_equal(
+                        np.asarray(probs["probs"], np.float32),
+                        control_probs[tenant],
+                    ):
+                        violations.append(
+                            f"tenant {tenant} probs differ from its "
+                            "single-tenant control — paging changed results"
+                        )
+            # (4) isolation: tenant A's id as tenant B = honest 404
+            try:
+                _post(
+                    "/predict",
+                    {"adaptation_id": ids[tenant_ids[0]],
+                     "x_query": x_q.tolist(), "tenant": tenant_ids[1]},
+                )
+                violations.append(
+                    "tenant B resolved tenant A's adaptation id — "
+                    "cross-tenant weight leak"
+                )
+            except urllib.error.HTTPError as exc:
+                if exc.code != 404:
+                    violations.append(
+                        f"cross-tenant predict returned {exc.code}, not 404"
+                    )
+                rid = exc.headers.get("X-Request-Id")
+                if rid:
+                    non_200_ids.append((exc.code, rid))
+            # unknown tenant = 400 on the wire
+            try:
+                _post("/adapt", {**payload, "tenant": "nobody"})
+                violations.append("unknown tenant adapt returned 200")
+            except urllib.error.HTTPError as exc:
+                if exc.code != 400:
+                    violations.append(
+                        f"unknown tenant returned {exc.code}, not 400"
+                    )
+                rid = exc.headers.get("X-Request-Id")
+                if rid:
+                    non_200_ids.append((exc.code, rid))
+            # (2) zero outside-prewarm compiles across the whole thrash
+            snap = engine.recompile_guard.snapshot()
+            if not snap["prewarmed"] or snap["violations"]:
+                violations.append(
+                    f"sealed-guard invariant broken under tenant thrash: {snap}"
+                )
+            # (3) the budget thrashed and /metrics says so
+            metrics = frontend.metrics()
+            json.dumps(metrics)  # observability stays well-formed
+            pager_stats = (metrics.get("tenants") or {}).get("pager") or {}
+            if not pager_stats.get("evictions"):
+                violations.append(
+                    f"no evictions under a budget fitting 2 of 4 tenants: "
+                    f"{pager_stats}"
+                )
+            by_tenant = (metrics.get("tenants") or {}).get("by_tenant") or {}
+            if set(by_tenant) < set(tenant_ids):
+                violations.append(
+                    f"/metrics tenants block missing tenants: {sorted(by_tenant)}"
+                )
+        finally:
+            server.shutdown()
+            server.server_close()
+            frontend.close()
+            thread.join(timeout=5)
+        # (3) evictions are logged events
+        events_path = os.path.join(access_dir, "events.jsonl")
+        evict_events = []
+        if os.path.exists(events_path):
+            with open(events_path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("event") == "tenant_evicted":
+                        evict_events.append(rec)
+        if not evict_events:
+            violations.append("no tenant_evicted event in events.jsonl")
+        # (4) every non-200 resolves to an access line, which carries tenants
+        records, torn = read_access_log(os.path.join(access_dir, "access.jsonl"))
+        if torn:
+            violations.append(f"{torn} torn access.jsonl line(s)")
+        logged_ids = {r.get("trace_id") for r in records}
+        for code, rid in non_200_ids:
+            if rid not in logged_ids:
+                violations.append(
+                    f"non-200 ({code}) request {rid} has no access-log line"
+                )
+        if not non_200_ids:
+            violations.append(
+                "drill produced no non-200 responses — invariant untested"
+            )
+        tenants_logged = {r.get("tenant") for r in records if r.get("tenant")}
+        if tenants_logged < set(tenant_ids):
+            violations.append(
+                f"access lines do not carry all tenants: {sorted(tenants_logged)}"
+            )
     else:
         violations.append(f"unknown serve episode kind {ep.kind!r}")
     return violations
@@ -871,12 +1069,22 @@ def tiny_serving_system(cfg):
     )
 
 
-def make_serving_run_dir(root: str, name: str, template: Optional[str] = None) -> str:
+def make_serving_run_dir(
+    root: str,
+    name: str,
+    template: Optional[str] = None,
+    perturb_seed: Optional[int] = None,
+) -> str:
     """A toy SERVING run dir a backend subprocess can load: config.yaml +
     an init-state checkpoint + logs/. ``template`` copies another run dir's
     config + checkpoint byte-for-byte (same fingerprint => the fleet's
     backends agree about every session's cache key — exactly the deployed
-    shape, where every host serves the same pushed checkpoint)."""
+    shape, where every host serves the same pushed checkpoint).
+    ``perturb_seed`` deterministically perturbs the init params before
+    saving, so multi-tenant drills get DISTINCT checkpoints (distinct
+    fingerprints, distinct predictions) that still share the one tree
+    structure the compiled programs key on — the deterministic init would
+    otherwise hand every "tenant" the same fingerprint."""
     import shutil
 
     run_dir = os.path.join(root, name)
@@ -916,7 +1124,21 @@ def make_serving_run_dir(root: str, name: str, template: Optional[str] = None) -
     )
     save_config(cfg, os.path.join(run_dir, "config.yaml"))
     system = tiny_serving_system(cfg)
-    ckpt.save_named(save_dir, system.init_train_state(), {"epoch": 0}, "latest")
+    state = system.init_train_state()
+    if perturb_seed is not None:
+        import jax
+        import numpy as np
+
+        rng = np.random.default_rng(perturb_seed)
+
+        def _perturb(leaf):
+            a = np.asarray(leaf)
+            if not np.issubdtype(a.dtype, np.floating):
+                return leaf
+            return a + (0.01 * rng.standard_normal(a.shape)).astype(a.dtype)
+
+        state = state._replace(params=jax.tree.map(_perturb, state.params))
+    ckpt.save_named(save_dir, state, {"epoch": 0}, "latest")
     return run_dir
 
 

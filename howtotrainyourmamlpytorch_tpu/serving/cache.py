@@ -23,7 +23,8 @@ CacheKey = Tuple[str, str, str]
 
 
 def support_digest(
-    x_support, y_support, num_steps: int, strategy: str = "maml++"
+    x_support, y_support, num_steps: int, strategy: str = "maml++",
+    tenant: Optional[str] = None,
 ) -> str:
     """Content hash of one adapt request: support tensors + shapes + dtypes +
     the inner-step horizon (the same support set adapted for a different
@@ -31,8 +32,11 @@ def support_digest(
     a ProtoNet prototype table and a MAML fast-weight tree for the same
     support set are different sessions, so their adaptation ids (and with
     them every cache key, session-spill file, and gateway affinity hash)
-    never collide. The default strategy contributes nothing to the hash, so
-    every pre-registry adaptation id is unchanged."""
+    never collide. ``tenant`` folds in the same way (serving/tenancy.py):
+    the same support set adapted against two tenants' masters is two
+    sessions, and the gateway's body-hash affinity separates them for free.
+    The default strategy and the default/absent tenant contribute nothing
+    to the hash, so every pre-tenancy adaptation id is unchanged."""
     h = hashlib.sha256()
     for arr in (x_support, y_support):
         a = np.ascontiguousarray(arr)
@@ -42,6 +46,8 @@ def support_digest(
     h.update(str(int(num_steps)).encode())
     if strategy != "maml++":
         h.update(f"strategy:{strategy}".encode())
+    if tenant:
+        h.update(f"tenant:{tenant}".encode())
     return h.hexdigest()
 
 
@@ -129,6 +135,20 @@ class AdaptedWeightCache:
                 _, (_, evicted_bytes, _) = self._entries.popitem(last=False)
                 self._bytes -= evicted_bytes
                 self.evictions += 1
+
+    def bytes_for_fingerprint(self, fingerprint: str) -> int:
+        """Live adapted-session bytes keyed under one checkpoint fingerprint
+        — the honest denominator for a per-tenant resident-bytes quota
+        (serving/tenancy.py::TenantQuotas): summed from the actual entries,
+        not estimated from counters."""
+        now = self._clock()
+        with self._lock:
+            self._expire_locked(now)
+            return sum(
+                nbytes
+                for key, (_, nbytes, _) in self._entries.items()
+                if key[0] == fingerprint
+            )
 
     def snapshot_entries(self):
         """``[(key, tree, age_s)]`` of every live (unexpired) entry, LRU

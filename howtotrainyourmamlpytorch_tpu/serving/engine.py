@@ -77,6 +77,7 @@ class AdaptationEngine:
         compile_ledger=None,
         device=None,
         ledger_tag: str = "",
+        registry=None,
     ):
         self.system = system
         self.cfg = system.cfg
@@ -128,6 +129,29 @@ class AdaptationEngine:
         self.strategies = tuple(
             getattr(self.serving, "strategies", None) or ("maml++",)
         )
+        # multi-tenant mode (serving/registry.py + serving/tenancy.py):
+        # with a registry the engine compiles state-as-ARGUMENT programs —
+        # same (strategy, size, batch) keys, same planned set, compiled
+        # once at prewarm and shared by every tenant; dispatch passes the
+        # pager-resolved device-resident master. Without one (the default),
+        # programs close over self.state exactly as before, keeping the
+        # default path's jaxprs byte-identical. The pager is per-engine so
+        # each fleet replica owns its device's tenant residency.
+        self.registry = registry
+        self.pager = None
+        if registry is not None:
+            from .tenancy import WeightPager
+
+            registry.template = self.state
+            self.pager = WeightPager(
+                registry,
+                self.state,
+                device=device,
+                budget_bytes=getattr(self.serving, "tenant_budget_bytes", 0),
+                min_headroom_frac=getattr(
+                    self.serving, "tenant_min_headroom_frac", 0.0
+                ),
+            )
         # jit caches keyed by (strategy, padded size, task-batch bucket);
         # device
         # dispatch is serialized by the batcher's worker thread, but direct
@@ -176,9 +200,18 @@ class AdaptationEngine:
         if checkpoint_idx == "best" and not ckpt.checkpoint_exists(save_dir, "best"):
             checkpoint_idx = "latest"
         state, _ = ckpt.load_for_inference(save_dir, checkpoint_idx)
+        # tenant registry (serving/registry.py): an explicit
+        # serving.tenant_registry path, or tenants.yaml in the run dir —
+        # absent, the engine is the single-tenant pre-tenancy one exactly
+        from .registry import TenantRegistry
+
+        registry = TenantRegistry.discover(cfg.serving, run_dir=run_dir)
         # serving knobs come from the (possibly overridden) run config even
         # when the caller supplies a pre-built system
-        engine = cls(system or MAMLSystem(cfg), state, serving_cfg=cfg.serving)
+        engine = cls(
+            system or MAMLSystem(cfg), state, serving_cfg=cfg.serving,
+            registry=registry,
+        )
         # prewarm() can reach the run's executable store: a freshly spawned
         # replica deserializes the stored serving executables instead of
         # tracing+compiling the grid (compile/aot.py)
@@ -203,6 +236,7 @@ class AdaptationEngine:
             compile_ledger=self.compile_ledger,
             device=device,
             ledger_tag=f"@r{index}",
+            registry=self.registry,
         )
         # replicas of a run-dir engine share its executable store: the
         # first replica's serialized executables warm every later one
@@ -226,7 +260,27 @@ class AdaptationEngine:
                     self.recompile_guard.note((kind, support_size, batch))
                 system, state, num_steps = self.system, self.state, self.num_steps
 
-                if strategy == "protonet":
+                if self.pager is not None:
+                    # tenant mode: the master state is a program ARGUMENT
+                    # under the same shape-keyed program key — every tenant
+                    # whose checkpoint shares the template's tree shapes
+                    # dispatches into this one prewarmed executable
+                    if strategy == "protonet":
+                        def adapt_batched(st, xs, ys, ws):
+                            return jax.vmap(
+                                lambda x, y, w: system.protonet_adapt(
+                                    st, x, y, support_weight=w
+                                )
+                            )(xs, ys, ws)
+                    else:
+                        def adapt_batched(st, xs, ys, ws):
+                            return jax.vmap(
+                                lambda x, y, w: system.adapt_fast_weights(
+                                    st, x, y, num_steps=num_steps,
+                                    support_weight=w, strategy=strategy,
+                                )
+                            )(xs, ys, ws)
+                elif strategy == "protonet":
                     # forward-only tier: one embedding forward + prototype
                     # reduction per task — zero gradients in the program
                     def adapt_batched(xs, ys, ws):
@@ -271,7 +325,28 @@ class AdaptationEngine:
                 system, state = self.system, self.state
                 bn_state = state.bn_state
 
-                if strategy == "protonet":
+                if self.pager is not None:
+                    # tenant mode: the master is an argument (see
+                    # _compiled_adapt) — the tenant's BN statistics and, for
+                    # protonet, its embedding params flow from the paged
+                    # state, never the default master's
+                    if strategy == "protonet":
+                        def predict_batched(st, fw, xs, ws):
+                            logits = jax.vmap(
+                                lambda p, x, w: system.protonet_predict_logits(
+                                    st.params, st.bn_state, p, x, w
+                                )
+                            )(fw, xs, ws)
+                            return jax.nn.softmax(logits, axis=-1)
+                    else:
+                        def predict_batched(st, fw, xs, ws):
+                            logits = jax.vmap(
+                                lambda p, x, w: system.predict_logits(
+                                    p, st.bn_state, x, w
+                                )
+                            )(fw, xs, ws)
+                            return jax.nn.softmax(logits, axis=-1)
+                elif strategy == "protonet":
                     # fw is a prototype table per item; queries embed
                     # through the shared master params
                     def predict_batched(fw, xs, ws):
@@ -383,6 +458,9 @@ class AdaptationEngine:
                 # the configured adaptation-strategy menu (first = default)
                 "strategies": list(self.strategies),
             }
+            if self.registry is not None:
+                # tenant mode: same program set, state passed as an argument
+                out["tenants"] = list(self.registry.tenants())
         if self.recompile_guard is not None:
             out["recompile_guard"] = self.recompile_guard.snapshot()
         if self.compile_ledger is not None:
@@ -428,8 +506,21 @@ class AdaptationEngine:
             if c is not None:
                 c.dispatch_s = seconds
 
+    def _tenant_state(self, tenant: Optional[str]):
+        """The pager-resolved master for a dispatch (None when the engine
+        is single-tenant — the programs close over ``self.state``)."""
+        if self.pager is None:
+            if tenant is not None:
+                raise ValueError(
+                    f"request names tenant {tenant!r} but this engine has no "
+                    "tenant registry (serving.tenant_registry)"
+                )
+            return None
+        return self.pager.resident(tenant)
+
     def adapt_batch(self, items: List[Tuple[Any, Any]], ctxs=None,
-                    strategy: Optional[str] = None):
+                    strategy: Optional[str] = None,
+                    tenant: Optional[str] = None):
         """Adapt a same-bucket group of support sets in one device dispatch.
         ``items`` is a list of ``(x_support, y_support)``; returns one
         adapted-parameter pytree per item (device arrays, stackable into the
@@ -438,8 +529,12 @@ class AdaptationEngine:
         batcher) get the dispatch seconds stamped and their trace flows
         finished at the dispatch span. ``strategy`` names the adaptation
         strategy for the WHOLE group (the batcher never mixes strategies in
-        one flush — the group key carries it); None = the engine default."""
+        one flush — the group key carries it); None = the engine default.
+        ``tenant`` likewise names the master the WHOLE group adapts against
+        (the group key carries it too — a flush never mixes weights);
+        None = the engine's own checkpoint."""
         strategy = validate_request_strategy(strategy, self.strategies)
+        state_arg = self._tenant_state(tenant)
         self.injector.fire("serving.dispatch")
         flat = [self._flatten_support(x, y) for x, y in items]
         sizes = {x.shape[0] for x, _ in flat}
@@ -457,29 +552,40 @@ class AdaptationEngine:
         while len(xs) < b:  # pad the task axis by replicating the last task
             xs.append(xs[-1]); ys.append(ys[-1]); ws.append(ws[-1])
         fn = self._compiled_adapt(bucket, b, strategy=strategy)
+        span_kw = dict(batch=n, bucket=bucket, strategy=strategy)
+        if tenant is not None:
+            span_kw["tenant"] = tenant
         t0 = time.monotonic()
         with self.tracer.span(
-            "serve.adapt_dispatch", flows=self._dispatch_flows(ctxs),
-            batch=n, bucket=bucket, strategy=strategy,
+            "serve.adapt_dispatch", flows=self._dispatch_flows(ctxs), **span_kw
         ):
-            stacked = fn(np.stack(xs), np.stack(ys), np.stack(ws))
+            if self.pager is not None:
+                stacked = fn(state_arg, np.stack(xs), np.stack(ys), np.stack(ws))
+            else:
+                stacked = fn(np.stack(xs), np.stack(ys), np.stack(ws))
         self._stamp_dispatch(ctxs, time.monotonic() - t0)
         return [jax.tree.map(lambda a, i=i: a[i], stacked) for i in range(n)]
 
-    def adapt(self, x_support, y_support, strategy: Optional[str] = None):
+    def adapt(self, x_support, y_support, strategy: Optional[str] = None,
+              tenant: Optional[str] = None):
         """Single-task convenience wrapper over :meth:`adapt_batch`."""
-        return self.adapt_batch([(x_support, y_support)], strategy=strategy)[0]
+        return self.adapt_batch(
+            [(x_support, y_support)], strategy=strategy, tenant=tenant
+        )[0]
 
     def predict_batch(self, items: List[Tuple[Any, Any]], ctxs=None,
-                      strategy: Optional[str] = None) -> List[np.ndarray]:
+                      strategy: Optional[str] = None,
+                      tenant: Optional[str] = None) -> List[np.ndarray]:
         """Forward a same-bucket group of query batches, each through its own
         adapted weights, in one device dispatch. ``items`` is a list of
         ``(fast_weights, x_query)``; returns per-item softmax probabilities
-        [Q_i, num_classes] as host arrays, padding sliced off. ``ctxs`` and
-        ``strategy`` as in :meth:`adapt_batch` (the fast weights must come
-        from the SAME strategy's adapt — a prototype table only scores
-        through the protonet predict program)."""
+        [Q_i, num_classes] as host arrays, padding sliced off. ``ctxs``,
+        ``strategy`` and ``tenant`` as in :meth:`adapt_batch` (the fast
+        weights must come from the SAME strategy's — and tenant's — adapt;
+        a prototype table only scores through the protonet predict
+        program)."""
         strategy = validate_request_strategy(strategy, self.strategies)
+        state_arg = self._tenant_state(tenant)
         self.injector.fire("serving.dispatch")
         # parses host-side request payloads (JSON-decoded lists), not device
         # values  # graftlint: disable=GL110
@@ -498,19 +604,28 @@ class AdaptationEngine:
             xs.append(xs[-1]); ws.append(ws[-1]); trees.append(trees[-1])
         stacked_fw = jax.tree.map(lambda *leaves: jnp.stack(leaves), *trees)
         fn = self._compiled_predict(bucket, b, strategy=strategy)
+        span_kw = dict(batch=n, bucket=bucket, strategy=strategy)
+        if tenant is not None:
+            span_kw["tenant"] = tenant
         t0 = time.monotonic()
         with self.tracer.span(
             "serve.predict_dispatch", flows=self._dispatch_flows(ctxs),
-            batch=n, bucket=bucket, strategy=strategy,
+            **span_kw,
         ):
+            if self.pager is not None:
+                out = fn(state_arg, stacked_fw, np.stack(xs), np.stack(ws))
+            else:
+                out = fn(stacked_fw, np.stack(xs), np.stack(ws))
             # deliberate sync: predictions must land host-side to serialize
             # back to clients — this is the flush's one device round-trip
-            # graftlint: disable=GL110
-            probs = np.asarray(fn(stacked_fw, np.stack(xs), np.stack(ws)))
+            probs = np.asarray(out)  # graftlint: disable=GL110
         self._stamp_dispatch(ctxs, time.monotonic() - t0)
         return [probs[i, : sizes[i]] for i in range(n)]
 
     def predict(self, fast_weights, x_query,
-                strategy: Optional[str] = None) -> np.ndarray:
+                strategy: Optional[str] = None,
+                tenant: Optional[str] = None) -> np.ndarray:
         """Single-request convenience wrapper over :meth:`predict_batch`."""
-        return self.predict_batch([(fast_weights, x_query)], strategy=strategy)[0]
+        return self.predict_batch(
+            [(fast_weights, x_query)], strategy=strategy, tenant=tenant
+        )[0]

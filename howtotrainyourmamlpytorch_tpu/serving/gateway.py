@@ -458,6 +458,13 @@ class Gateway:
         header dict."""
         t0 = time.monotonic()
         trace_id, span_id, parent_id = _parse_traceparent(traceparent)
+        # the tenant the request names (serving/tenancy.py) rides every
+        # gateway access line — parsed lazily, only when lines are written
+        # (a local, not instance state: the threaded handler proxies
+        # concurrently)
+        tenant = (
+            _safe_json(body).get("tenant") if self.access is not None else None
+        )
         out_headers: Dict[str, str] = {
             "X-Request-Id": trace_id,
             "traceparent": _format_traceparent(trace_id, span_id),
@@ -476,18 +483,21 @@ class Gateway:
                 {"error": "gateway at max_inflight — shed at admission",
                  "retry_after_s": self.retry_after_s}
             ).encode()
-            self._access(trace_id, parent_id, path, "shed", 429, None, 0, t0)
+            self._access(trace_id, parent_id, path, "shed", 429, None, 0, t0,
+                         tenant=tenant)
             return 429, out_headers, payload
         try:
             return self._proxy_routed(
-                path, body, trace_id, span_id, parent_id, out_headers, t0
+                path, body, trace_id, span_id, parent_id, out_headers, t0,
+                tenant=tenant,
             )
         finally:
             with self._lock:
                 self._inflight -= 1
 
     def _proxy_routed(
-        self, path, body, trace_id, span_id, parent_id, out_headers, t0
+        self, path, body, trace_id, span_id, parent_id, out_headers, t0,
+        tenant=None,
     ) -> Tuple[int, Dict[str, str], bytes]:
         key, preferred = self.affinity_key(path, body)
         fwd_headers = {
@@ -518,7 +528,8 @@ class Gateway:
                 self._learn_from_response(path, resp_body, backend)
                 out_headers["X-Gateway-Backend"] = backend.name
                 self._access(
-                    trace_id, parent_id, path, "ok", status, backend, retries, t0
+                    trace_id, parent_id, path, "ok", status, backend, retries,
+                    t0, tenant=tenant,
                 )
                 return status, out_headers, resp_body
             if self._retryable(status, resp_body):
@@ -537,7 +548,7 @@ class Gateway:
                 out_headers["Retry-After"] = up_headers["Retry-After"]
             self._access(
                 trace_id, parent_id, path, _outcome_of(status), status, backend,
-                retries, t0,
+                retries, t0, tenant=tenant,
             )
             return status, out_headers, resp_body
         # every live backend tried (or none was live)
@@ -551,7 +562,8 @@ class Gateway:
                 "retry_after_s": self.retry_after_s,
             }
         ).encode()
-        self._access(trace_id, parent_id, path, "no_backend", 503, None, retries, t0)
+        self._access(trace_id, parent_id, path, "no_backend", 503, None, retries,
+                     t0, tenant=tenant)
         return 503, out_headers, payload
 
     def _learn_from_response(self, path: str, resp_body: bytes, backend: Backend) -> None:
@@ -561,7 +573,8 @@ class Gateway:
                 self._learn_session(aid, backend)
 
     def _access(
-        self, trace_id, parent_id, verb, outcome, status, backend, retries, t0
+        self, trace_id, parent_id, verb, outcome, status, backend, retries, t0,
+        tenant=None,
     ) -> None:
         if self.access is None:
             return
@@ -573,6 +586,7 @@ class Gateway:
                 "verb": verb,
                 "outcome": outcome,
                 "status": status,
+                "tenant": tenant,
                 "backend": backend.name if backend is not None else None,
                 "retries": retries,
                 "total_ms": round((time.monotonic() - t0) * 1e3, 3),

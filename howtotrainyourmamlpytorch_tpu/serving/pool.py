@@ -34,9 +34,21 @@ from .errors import ServiceUnavailableError
 
 def _key_strategy(key) -> "str | None":
     """Strategy component of a batcher group key: ``(strategy, bucket)``
-    tuples carry one; bare buckets (legacy callers, tests) mean the engine
-    default (None)."""
+    and ``(tenant, strategy, bucket)`` tuples carry one; bare buckets
+    (legacy callers, tests) mean the engine default (None)."""
+    if isinstance(key, tuple) and len(key) == 3:
+        return key[1]
     if isinstance(key, tuple) and len(key) == 2 and isinstance(key[0], str):
+        return key[0]
+    return None
+
+
+def _key_tenant(key) -> "str | None":
+    """Tenant component of a batcher group key: only the 3-tuple
+    ``(tenant, strategy, bucket)`` form carries one — default-tenant
+    traffic keeps the legacy key shapes, so a flush of mixed shapes is
+    impossible and pre-tenancy group keys stay byte-identical."""
+    if isinstance(key, tuple) and len(key) == 3:
         return key[0]
     return None
 
@@ -77,14 +89,17 @@ class EngineReplica:
         # of the observability contract single-replica consumers pin
         suffix = "" if solo else f"-r{self.index}"
         continuous = getattr(serving_cfg, "continuous_batching", False)
-        # the batcher group key is either a bare shape bucket (legacy
-        # callers/tests) or (strategy, bucket) from the frontend — requests
-        # of different adaptation strategies compile different programs and
-        # must never share a flush, so the strategy rides the grouping key
-        # and is unpacked here for the engine
+        # the batcher group key is a bare shape bucket (legacy
+        # callers/tests), (strategy, bucket), or (tenant, strategy, bucket)
+        # from the frontend — requests of different adaptation strategies
+        # compile different programs, and requests of different tenants
+        # adapt against different masters, so neither may ever share a
+        # flush: both ride the grouping key and are unpacked here for the
+        # engine
         self.adapt_batcher = MicroBatcher(
             lambda key, payloads, ctxs: self.engine.adapt_batch(
-                payloads, ctxs=ctxs, strategy=_key_strategy(key)
+                payloads, ctxs=ctxs, strategy=_key_strategy(key),
+                tenant=_key_tenant(key),
             ),
             max_batch=serving_cfg.max_batch_size,
             deadline_ms=serving_cfg.batch_deadline_ms,
@@ -96,7 +111,8 @@ class EngineReplica:
         )
         self.predict_batcher = MicroBatcher(
             lambda key, payloads, ctxs: self.engine.predict_batch(
-                payloads, ctxs=ctxs, strategy=_key_strategy(key)
+                payloads, ctxs=ctxs, strategy=_key_strategy(key),
+                tenant=_key_tenant(key),
             ),
             max_batch=serving_cfg.max_batch_size,
             deadline_ms=serving_cfg.batch_deadline_ms,
@@ -352,6 +368,32 @@ class EnginePool:
         }
         total = out["hits"] + out["misses"]
         out["hit_rate"] = (out["hits"] / total) if total else 0.0
+        return out
+
+    def pager_stats(self) -> Optional[Dict[str, Any]]:
+        """Fleet-aggregate weight-pager stats (serving/tenancy.py), or None
+        when the fleet is single-tenant: counts summed across the distinct
+        engines' pagers, residency reported per engine (each device owns
+        its own resident set)."""
+        pagers = [
+            e.pager for e in self.engines()
+            if getattr(e, "pager", None) is not None
+        ]
+        if not pagers:
+            return None
+        rows = [p.stats() for p in pagers]
+        out: Dict[str, Any] = {
+            key: sum(row[key] for row in rows)
+            for key in ("resident", "resident_bytes", "page_ins", "evictions")
+        }
+        out["budget_bytes"] = rows[0]["budget_bytes"]
+        out["resident_tenants"] = sorted(
+            {t for row in rows for t in row["resident_tenants"]}
+        )
+        p50s = [row["page_in_p50_ms"] for row in rows if row["page_in_p50_ms"] is not None]
+        out["page_in_p50_ms"] = (
+            round(sorted(p50s)[len(p50s) // 2], 3) if p50s else None
+        )
         return out
 
     def stats(self) -> List[Dict[str, Any]]:

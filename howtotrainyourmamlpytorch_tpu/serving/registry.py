@@ -1,0 +1,213 @@
+"""Tenant model registry: many checkpoints behind one serving fleet.
+
+A frontend used to serve exactly one checkpoint fingerprint; the registry
+maps **tenant ids** to checkpoint run directories so thousands of fine-tuned
+variants can sit behind the same compiled engine. Master weights load
+**lazily into host RAM** (a registry naming 1000 tenants costs nothing until
+traffic arrives for one) and are keyed on the existing sha256 checkpoint
+fingerprints — the same content address the adapted-weight cache, session
+store, and gateway affinity already use, so tenant isolation falls out of
+content addressing rather than a parallel namespace.
+
+The registry is pure host-side bookkeeping: paging masters onto a device
+under a byte budget is ``serving/tenancy.py::WeightPager``'s job, and the
+compiled programs never key on a tenant at all (the program set is
+shape-keyed — ``docs/OPERATIONS.md`` "Multi-tenant serving").
+
+Registry sources, in precedence order:
+
+1. an explicit ``serving.tenant_registry`` YAML path;
+2. ``<run_dir>/tenants.yaml`` next to the served run's ``config.yaml``.
+
+YAML format (``checkpoint`` optional, ``best`` with a ``latest`` fallback,
+matching ``AdaptationEngine.from_run_dir``)::
+
+    tenants:
+      acme:
+        run_dir: exps/acme_finetune
+        checkpoint: best
+"""
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+from ..core import TrainState
+from ..experiment import checkpoint as ckpt
+
+
+def _tree_shapes(tree: Any) -> List[Tuple[str, Tuple[int, ...]]]:
+    """Sorted (path, shape) pairs — the structural identity two checkpoints
+    must share to flow through one shape-keyed compiled program. Optimizer
+    state is excluded: serving never touches it, and registry loads always
+    come back with ``opt_state=None`` while a directly-constructed fleet
+    master may still carry one."""
+    if hasattr(tree, "_replace"):
+        tree = tree._replace(opt_state=None)
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return sorted(
+        (jax.tree_util.keystr(path), tuple(np.shape(leaf)))
+        for path, leaf in flat
+    )
+
+
+class TenantRegistry:
+    """Lazy host-RAM store of tenant master states, fingerprint-keyed.
+
+    ``entries`` maps tenant id -> ``{"run_dir": ..., "checkpoint": ...}``.
+    ``host_state(tenant)`` loads the checkpoint on first use (host numpy
+    arrays — no device memory until the pager asks) and validates its tree
+    structure against ``template`` when one is set: a tenant whose backbone
+    differs from the fleet master cannot share the compiled programs, and
+    failing at load beats recompiling on the serving hot path."""
+
+    def __init__(self, entries: Dict[str, Dict[str, Any]], base_dir: str = ""):
+        self.base_dir = base_dir
+        self._entries: Dict[str, Dict[str, Any]] = {}
+        for tenant, spec in entries.items():
+            if not isinstance(spec, dict) or "run_dir" not in spec:
+                raise ValueError(
+                    f"tenant {tenant!r}: registry entry must be a mapping "
+                    f"with a run_dir, got {spec!r}"
+                )
+            self._entries[str(tenant)] = {
+                "run_dir": str(spec["run_dir"]),
+                "checkpoint": str(spec.get("checkpoint", "best")),
+            }
+        if not self._entries:
+            raise ValueError("tenant registry names no tenants")
+        self._lock = threading.Lock()
+        # tenant -> (host TrainState, fingerprint); populated lazily
+        self._masters: Dict[str, Tuple[TrainState, str]] = {}
+        self.template: Optional[Any] = None
+        self.loads = 0
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "TenantRegistry":
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        if not isinstance(doc, dict) or not isinstance(doc.get("tenants"), dict):
+            raise ValueError(
+                f"tenant registry {path}: expected a top-level 'tenants' mapping"
+            )
+        # relative run_dirs resolve against the registry file's directory,
+        # so a registry travels with the run tree it names
+        return cls(doc["tenants"], base_dir=os.path.dirname(os.path.abspath(path)))
+
+    @classmethod
+    def discover(
+        cls, serving_cfg, run_dir: Optional[str] = None
+    ) -> Optional["TenantRegistry"]:
+        """The two registry sources, explicit path winning. None = the
+        single-tenant mode every pre-tenancy deployment runs in."""
+        explicit = getattr(serving_cfg, "tenant_registry", None)
+        if explicit:
+            return cls.from_yaml(explicit)
+        if run_dir:
+            auto = os.path.join(run_dir, "tenants.yaml")
+            if os.path.exists(auto):
+                return cls.from_yaml(auto)
+        return None
+
+    # -- lookup ----------------------------------------------------------
+
+    def tenants(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._entries))
+
+    def __contains__(self, tenant: str) -> bool:
+        return tenant in self._entries
+
+    def _resolve_run_dir(self, tenant: str) -> str:
+        run_dir = self._entries[tenant]["run_dir"]
+        if not os.path.isabs(run_dir) and self.base_dir:
+            run_dir = os.path.join(self.base_dir, run_dir)
+        return run_dir
+
+    def host_state(self, tenant: str) -> Tuple[TrainState, str]:
+        """(host-RAM master TrainState, checkpoint fingerprint) for one
+        tenant, loaded on first use and cached (masters are immutable)."""
+        if tenant not in self._entries:
+            raise KeyError(f"unknown tenant {tenant!r}")
+        with self._lock:
+            cached = self._masters.get(tenant)
+            if cached is not None:
+                return cached
+            save_dir = os.path.join(self._resolve_run_dir(tenant), "saved_models")
+            idx = self._entries[tenant]["checkpoint"]
+            if idx == "best" and not ckpt.checkpoint_exists(save_dir, "best"):
+                idx = "latest"
+            inf, _ = ckpt.load_for_inference(save_dir, idx)
+            state = TrainState(
+                params=inf.params,
+                bn_state=inf.bn_state,
+                inner_hparams=inf.inner_hparams,
+                opt_state=None,
+                step=jnp.asarray(inf.step, jnp.int32),
+            )
+            if self.template is not None and _tree_shapes(state) != _tree_shapes(
+                self.template
+            ):
+                raise ValueError(
+                    f"tenant {tenant!r}: checkpoint structure differs from the "
+                    "fleet master — it cannot share the shape-keyed compiled "
+                    "programs (serve it from its own fleet)"
+                )
+            self._masters[tenant] = (state, inf.fingerprint)
+            self.loads += 1
+            return self._masters[tenant]
+
+    def fingerprint(self, tenant: str) -> str:
+        return self.host_state(tenant)[1]
+
+    def hosted_fingerprints(self) -> Dict[str, str]:
+        """tenant -> fingerprint for masters ALREADY in host RAM (no loads
+        triggered) — the drain spill's reverse map: only a loaded tenant
+        can have adapted sessions in any cache."""
+        with self._lock:
+            return {t: fp for t, (_, fp) in self._masters.items()}
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "tenants": len(self._entries),
+                "hosted": len(self._masters),
+                "loads": self.loads,
+            }
+
+
+def synthetic_registry(
+    tenant_ids, state, root: str, seed: int = 0
+) -> TenantRegistry:
+    """N deterministically-perturbed copies of ``state`` saved as real
+    checkpoints under ``root`` (one run dir per tenant), behind a
+    TenantRegistry — the in-process multi-tenant backend loadgen and
+    bench_serving share (same idea as the chaos campaign's perturbed toy
+    run dirs, without needing an existing run dir)."""
+    entries: Dict[str, Dict[str, Any]] = {}
+    for i, tenant in enumerate(tenant_ids):
+        rng = np.random.default_rng((int(seed) << 8) + i + 1)
+
+        def _perturb(leaf):
+            a = np.asarray(leaf)
+            if not np.issubdtype(a.dtype, np.floating):
+                return leaf
+            return a + (0.01 * rng.standard_normal(a.shape)).astype(a.dtype)
+
+        run_dir = os.path.join(root, str(tenant))
+        save_dir = os.path.join(run_dir, "saved_models")
+        os.makedirs(save_dir, exist_ok=True)
+        ckpt.save_named(
+            save_dir,
+            state._replace(params=jax.tree.map(_perturb, state.params)),
+            {"epoch": 0},
+            "latest",
+        )
+        entries[str(tenant)] = {"run_dir": run_dir, "checkpoint": "latest"}
+    return TenantRegistry(entries)

@@ -58,6 +58,26 @@ from .errors import ServiceUnavailableError, UnknownAdaptationError  # noqa: F40
 from .metrics import EventCounters, LatencyStats
 from .pool import EnginePool
 from .router import Router, rendezvous_score
+from .tenancy import QuotaExceededError, validate_request_tenant
+
+
+class _LazyTenantFingerprints:
+    """Mapping view the session rehydrator hands ``SessionStore.load_all``:
+    ``get(tenant)`` resolves a REGISTERED tenant's checkpoint fingerprint on
+    demand (loading its master into host RAM only then), so rehydrating a
+    run dir with zero spilled tenant sessions never touches a tenant
+    checkpoint — the registry stays lazy."""
+
+    def __init__(self, registry):
+        self._registry = registry
+
+    def get(self, tenant, default=None):
+        if tenant not in self._registry:
+            return default
+        try:
+            return self._registry.fingerprint(tenant)
+        except Exception:  # noqa: BLE001 — an unloadable tenant is foreign
+            return default
 
 
 class ServingFrontend:
@@ -174,6 +194,26 @@ class ServingFrontend:
             ),
             shed_retry_after_s=self.resilience.shed_retry_after_s,
         )
+        # --- multi-tenant serving (serving/registry.py + tenancy.py) ------
+        # quotas + the HBM-watermark eviction signal only exist when the
+        # engine carries a registry; single-tenant frontends pay nothing
+        self.quotas = None
+        if engine.registry is not None:
+            from .tenancy import TenantQuotas
+
+            self.quotas = TenantQuotas(
+                max_inflight=getattr(self.serving, "tenant_max_inflight", 0),
+                rate_rps=getattr(self.serving, "tenant_rate_rps", 0.0),
+                max_resident_bytes=getattr(
+                    self.serving, "tenant_max_resident_bytes", 0
+                ),
+            )
+            if self._memory is not None:
+                # PR 7's watermark provider is the pagers' eviction signal:
+                # real per-device HBM pressure preempts the static budget
+                for e in self.pool.engines():
+                    if getattr(e, "pager", None) is not None:
+                        e.pager.watermarks = self._memory
         # back-compat views: the single-replica surface tests, the SLO
         # harness, and operator tools read — all primary-replica objects
         primary = self.pool.replicas[0]
@@ -351,19 +391,91 @@ class ServingFrontend:
 
     # ------------------------------------------------------------------
 
-    def _cache_key(self, digest: str, strategy: str) -> Tuple[str, str, str]:
+    def _cache_key(
+        self, digest: str, strategy: str, tenant: Optional[str] = None
+    ) -> Tuple[str, str, str]:
         """Adapted-session cache key: (checkpoint fingerprint, strategy,
         adaptation id). The strategy is an explicit component — a ProtoNet
         prototype table and a MAML fast-weight tree for the same support
         set must never collide — on top of being folded into the digest
-        itself (serving/cache.py::support_digest)."""
-        return (self.engine.fingerprint, strategy, digest)
+        itself (serving/cache.py::support_digest). A non-default tenant's
+        key carries THAT tenant's checkpoint fingerprint, so a cross-tenant
+        predict (tenant B naming tenant A's adaptation id) misses into the
+        honest 404 — it can never resolve to another tenant's weights."""
+        fp = (
+            self.engine.registry.fingerprint(tenant)
+            if tenant is not None
+            else self.engine.fingerprint
+        )
+        return (fp, strategy, digest)
 
     def _count_strategy(self, strategy: str, verb: str, outcome: str) -> None:
         """Per-strategy outcome tally (the /metrics ``strategies`` block and
         obs_top's live strategy mix read these): one increment per request,
         keyed ``serving.strategy.<name>.<verb>.<outcome>``."""
         self.hub.registry.inc(f"serving.strategy.{strategy}.{verb}.{outcome}")
+
+    def _count_tenant(self, tenant: Optional[str], verb: str, outcome: str) -> None:
+        """Per-tenant outcome tally, keyed
+        ``serving.tenant.<id>.<verb>.<outcome>`` — only in tenant mode, so
+        a single-tenant deployment's counter namespace is unchanged."""
+        if self.engine.registry is None:
+            return
+        self.hub.registry.inc(
+            f"serving.tenant.{tenant or 'default'}.{verb}.{outcome}"
+        )
+
+    def _acquire_quota(self, tenant: Optional[str]) -> Optional[str]:
+        """Per-tenant admission (rate + inflight token): returns the quota
+        label to release, or None when quotas are off. A breach becomes the
+        existing shed contract — 429 + honest ``Retry-After`` — and is
+        per-tenant by construction: other tenants' admission never sees it."""
+        if self.quotas is None or not self.quotas.enabled:
+            return None
+        label = tenant or "default"
+        try:
+            self.quotas.acquire(label)
+        except QuotaExceededError as exc:
+            self.counters.inc("tenant_quota_rejected")
+            raise ServiceUnavailableError(
+                str(exc), retry_after_s=exc.retry_after_s, status=429
+            ) from exc
+        return label
+
+    def _check_resident_quota(self, tenant: Optional[str], fingerprint: str) -> None:
+        """Before an adapt inserts NEW bytes: the tenant's live adapted-
+        session bytes (summed over every replica cache, honest — from the
+        entries, not counters) must fit its quota."""
+        if self.quotas is None or not self.quotas.max_resident_bytes:
+            return
+        resident = sum(
+            r.cache.bytes_for_fingerprint(fingerprint)
+            for r in self.pool.replicas
+        )
+        try:
+            self.quotas.check_resident_bytes(tenant or "default", resident)
+        except QuotaExceededError as exc:
+            self.counters.inc("tenant_quota_rejected")
+            raise ServiceUnavailableError(
+                str(exc), retry_after_s=exc.retry_after_s, status=429
+            ) from exc
+
+    def _sweep_pagers(self) -> None:
+        """HBM-watermark eviction sweep: ask each engine's pager to evict
+        its LRU tenant while the tightest per-device headroom sits below the
+        configured floor. Free when the knob is off (the pager returns
+        immediately); called after tenant-mode dispatches."""
+        for e in self.pool.engines():
+            pager = getattr(e, "pager", None)
+            if pager is None:
+                continue
+            pager.check_watermark()
+            for rec in pager.drain_events():
+                if rec["event"] == "tenant_evicted":
+                    self.counters.inc("tenant_evictions")
+                    if rec.get("reason") == "hbm_watermark":
+                        self.counters.inc("tenant_watermark_evictions")
+                self._event(rec.pop("event"), **rec)
 
     def _request_ctx(self, ctx: Optional[RequestContext]) -> Optional[RequestContext]:
         """The per-request trace identity: adopt the caller's (HTTP layer,
@@ -538,6 +650,18 @@ class ServingFrontend:
             row["requests"] += value
         return out
 
+    def tenant_stats(self) -> Dict[str, Any]:
+        """Per-tenant request/outcome tallies (same schema as
+        :meth:`strategy_stats`, keyed ``serving.tenant.<id>.<verb>.<outcome>``)
+        — the ``by_tenant`` half of the /metrics ``tenants`` block."""
+        out: Dict[str, Any] = {}
+        for name, value in self.hub.registry.counters("serving.tenant.").items():
+            t, _, rest = name.partition(".")  # rest = "<verb>.<outcome>"
+            row = out.setdefault(t, {"requests": 0})
+            row[rest] = row.get(rest, 0) + value
+            row["requests"] += value
+        return out
+
     def kill_replica(self, index: int, reason: str = "operator") -> None:
         """Mark one replica dead (chaos drills, operator action): the
         router stops routing to it from the next request on, the rest of
@@ -698,11 +822,23 @@ class ServingFrontend:
         run dir, content-addressed + digest-wrapped (serving/sessions.py)."""
         count = 0
         ttl_s = float(self.serving.cache_ttl_s)
+        # reverse fingerprint -> tenant map: only a LOADED tenant master can
+        # have adapted sessions in any cache, so hosted_fingerprints covers
+        # every spillable tenant entry without touching cold checkpoints
+        tenant_by_fp: Dict[str, str] = {}
+        if self.engine.registry is not None:
+            tenant_by_fp = {
+                fp: t
+                for t, fp in self.engine.registry.hosted_fingerprints().items()
+            }
         for replica in self.pool.replicas:
             for key, tree, age_s in replica.cache.snapshot_entries():
                 fingerprint, strategy, digest = key
+                tenant = None
                 if fingerprint != self.engine.fingerprint:
-                    continue
+                    tenant = tenant_by_fp.get(fingerprint)
+                    if tenant is None:
+                        continue
                 if strategy == "protonet":
                     # a prototype table is one forward pass to recompute —
                     # not worth a spill file (and the rehydrate template is
@@ -710,7 +846,7 @@ class ServingFrontend:
                     continue
                 self.session_store.spill(
                     digest, tree, fingerprint, age_s=age_s, ttl_s=ttl_s,
-                    strategy=strategy,
+                    strategy=strategy, tenant=tenant,
                 )
                 count += 1
         if count:
@@ -726,8 +862,13 @@ class ServingFrontend:
         entries, stats = self.session_store.load_all(
             fingerprint=self.engine.fingerprint,
             template=self.engine.state.params,
+            tenant_fingerprints=(
+                _LazyTenantFingerprints(self.engine.registry)
+                if self.engine.registry is not None
+                else None
+            ),
         )
-        for digest, tree, lived_s, strategy in entries:
+        for digest, tree, lived_s, strategy, tenant in entries:
             replica = max(
                 self.pool.replicas,
                 key=lambda r: rendezvous_score(digest, r.index),
@@ -735,7 +876,7 @@ class ServingFrontend:
             # back-date by the TTL budget already consumed: a restart must
             # never extend a session's original expiry
             replica.cache.put(
-                self._cache_key(digest, strategy), tree, age_s=lived_s
+                self._cache_key(digest, strategy, tenant), tree, age_s=lived_s
             )
         self._session_stats = dict(stats, rehydrated=stats["loaded"])
         if any(stats.values()):
@@ -753,23 +894,32 @@ class ServingFrontend:
         y_support,
         ctx: Optional[RequestContext] = None,
         strategy: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> Dict[str, Any]:
-        # strategy resolution BEFORE the logged/gated section: an unknown
-        # name raises ValueError here, which the HTTP layer maps to 400 +
-        # its own bad_request access line (a valid-but-unconfigured name
-        # passes — strict mode rejects its unplanned program downstream)
+        # strategy/tenant resolution BEFORE the logged/gated section: an
+        # unknown name raises ValueError here, which the HTTP layer maps to
+        # 400 + its own bad_request access line (a valid-but-unconfigured
+        # strategy passes — strict mode rejects its unplanned program
+        # downstream; an unregistered tenant never does)
         strategy = validate_request_strategy(strategy, self.engine.strategies)
+        tenant = validate_request_tenant(tenant, self.engine.registry)
         ctx = self._request_ctx(ctx)
         if ctx is not None:
             ctx.strategy = strategy
+            ctx.tenant = tenant
         t0 = time.monotonic()
         entered = False
+        quota_label = None
         try:
             # drain gate + in-flight accounting: a request that passes here
             # is guaranteed to complete (or fail honestly) before a
             # graceful drain lets the process exit
             self._enter_request()
             entered = True
+            # per-tenant admission rides next: a tenant over its rate or
+            # inflight quota sheds 429 HERE, before any queue or dispatch,
+            # so it cannot degrade other tenants' p99
+            quota_label = self._acquire_quota(tenant)
             # the request's flow STARTS here (ph "s"); the batcher flush
             # steps it ("t") and the engine dispatch finishes it ("f") — one
             # linked arc HTTP thread -> worker flush -> device dispatch
@@ -778,10 +928,14 @@ class ServingFrontend:
                 trace=ctx.trace_id if ctx else None,
             ):
                 x, y = self.engine._flatten_support(x_support, y_support)
-                digest = support_digest(x, y, self.engine.num_steps, strategy)
-                key = self._cache_key(digest, strategy)
+                digest = support_digest(
+                    x, y, self.engine.num_steps, strategy, tenant=tenant
+                )
+                key = self._cache_key(digest, strategy, tenant)
                 # affinity on the cache key: this session's fast weights
-                # live (or will live) on exactly this replica's cache
+                # live (or will live) on exactly this replica's cache (the
+                # digest folds the tenant in, so tenants spread + stick
+                # independently)
                 replica = self.router.route(digest, ctx=ctx)
                 cached = replica.cache.get(key, ctx=ctx) is not None
                 if not cached:
@@ -789,24 +943,37 @@ class ServingFrontend:
                     # replica (a cache hit above costs nothing — only real
                     # work passes admission)
                     self.router.admit(replica)
+                    self._check_resident_quota(tenant, key[0])
                     bucket = self.engine.support_bucket(x.shape[0])
                     if ctx is not None:
                         ctx.bucket = bucket
                         ctx.true_size = int(x.shape[0])
-                    # the batcher group key carries the strategy: requests
-                    # of different strategies compile different programs
-                    # and must never share a flush
+                    # the batcher group key carries the strategy (and, for
+                    # non-default tenants, the tenant): requests of
+                    # different strategies compile different programs, and
+                    # different tenants adapt against different masters —
+                    # neither may ever share a flush
+                    group = (
+                        (tenant, strategy, bucket)
+                        if tenant is not None
+                        else (strategy, bucket)
+                    )
                     fast_weights = replica.dispatch(
-                        replica.adapt_batcher, (strategy, bucket), (x, y), ctx
+                        replica.adapt_batcher, group, (x, y), ctx
                     )
                     self._note_padding("adapt", x.shape[0], bucket, strategy)
                     replica.cache.put(key, fast_weights)
+                    if tenant is not None:
+                        self._sweep_pagers()
         except BaseException as exc:
             outcome, status = self._failure_of(exc)
             self._count_strategy(strategy, "adapt", outcome)
+            self._count_tenant(tenant, "adapt", outcome)
             self._record_access(ctx, "adapt", outcome, status, time.monotonic() - t0)
             raise
         finally:
+            if quota_label is not None:
+                self.quotas.release(quota_label)
             if entered:
                 self._exit_request()
         elapsed = time.monotonic() - t0
@@ -816,6 +983,7 @@ class ServingFrontend:
             # the aggregate (the default keeps the historical schema alone)
             self.latency.record(f"adapt@{strategy}", elapsed)
         self._count_strategy(strategy, "adapt", "ok")
+        self._count_tenant(tenant, "adapt", "ok")
         self._record_access(ctx, "adapt", "ok", 200, elapsed)
         out = {
             "adaptation_id": digest,
@@ -824,6 +992,8 @@ class ServingFrontend:
             "support_size": int(x.shape[0]),
             "latency_ms": round(elapsed * 1e3, 3),
         }
+        if tenant is not None:
+            out["tenant"] = tenant
         if ctx is not None:
             out["trace_id"] = ctx.trace_id
             out["timing"] = ctx.timing_ms(elapsed)
@@ -835,16 +1005,21 @@ class ServingFrontend:
         x_query,
         ctx: Optional[RequestContext] = None,
         strategy: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> np.ndarray:
         strategy = validate_request_strategy(strategy, self.engine.strategies)
+        tenant = validate_request_tenant(tenant, self.engine.registry)
         ctx = self._request_ctx(ctx)
         if ctx is not None:
             ctx.strategy = strategy
+            ctx.tenant = tenant
         t0 = time.monotonic()
         entered = False
+        quota_label = None
         try:
             self._enter_request()
             entered = True
+            quota_label = self._acquire_quota(tenant)
             with self.hub.span(
                 "serve.predict", flows=flow_start(ctx),
                 trace=ctx.trace_id if ctx else None,
@@ -854,13 +1029,15 @@ class ServingFrontend:
                 # lands on the replica whose cache holds them. After a
                 # replica death the key remaps and the miss below is the
                 # honest failover answer: re-adapt, never a stale result.
-                # A predict naming the WRONG strategy for its id misses the
-                # (fingerprint, strategy, id) key the same honest way — a
-                # prototype table is never pushed through a gradient
-                # strategy's predict program, or vice versa.
+                # A predict naming the WRONG strategy — or the wrong TENANT
+                # (the key carries the tenant's checkpoint fingerprint) —
+                # for its id misses the (fingerprint, strategy, id) key the
+                # same honest way: a prototype table is never pushed
+                # through a gradient strategy's predict program, and tenant
+                # B can never resolve tenant A's weights.
                 replica = self.router.route(adaptation_id, ctx=ctx)
                 fast_weights = replica.cache.get(
-                    self._cache_key(adaptation_id, strategy), ctx=ctx
+                    self._cache_key(adaptation_id, strategy, tenant), ctx=ctx
                 )
                 if fast_weights is None:
                     raise UnknownAdaptationError(
@@ -874,17 +1051,26 @@ class ServingFrontend:
                 if ctx is not None:
                     ctx.bucket = bucket
                     ctx.true_size = int(x.shape[0])
+                group = (
+                    (tenant, strategy, bucket)
+                    if tenant is not None
+                    else (strategy, bucket)
+                )
                 probs = replica.dispatch(
-                    replica.predict_batcher, (strategy, bucket),
-                    (fast_weights, x), ctx,
+                    replica.predict_batcher, group, (fast_weights, x), ctx,
                 )
                 self._note_padding("predict", x.shape[0], bucket, strategy)
+                if tenant is not None:
+                    self._sweep_pagers()
         except BaseException as exc:
             outcome, status = self._failure_of(exc)
             self._count_strategy(strategy, "predict", outcome)
+            self._count_tenant(tenant, "predict", outcome)
             self._record_access(ctx, "predict", outcome, status, time.monotonic() - t0)
             raise
         finally:
+            if quota_label is not None:
+                self.quotas.release(quota_label)
             if entered:
                 self._exit_request()
         elapsed = time.monotonic() - t0
@@ -892,6 +1078,7 @@ class ServingFrontend:
         if strategy != self.engine.strategies[0]:
             self.latency.record(f"predict@{strategy}", elapsed)
         self._count_strategy(strategy, "predict", "ok")
+        self._count_tenant(tenant, "predict", "ok")
         self._record_access(ctx, "predict", "ok", 200, elapsed)
         return probs
 
@@ -902,16 +1089,20 @@ class ServingFrontend:
         x_query,
         ctx: Optional[RequestContext] = None,
         strategy: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> Dict[str, Any]:
         # one client call, two hops: both access-log lines (verb adapt +
         # verb predict) share the request's trace id
         ctx = self._request_ctx(ctx)
         t0 = time.monotonic()
-        info = self.adapt(x_support, y_support, ctx=ctx, strategy=strategy)
+        info = self.adapt(
+            x_support, y_support, ctx=ctx, strategy=strategy, tenant=tenant
+        )
         if ctx is not None:
             ctx.access_logged = False  # the predict hop logs its own line
         probs = self.predict(
-            info["adaptation_id"], x_query, ctx=ctx, strategy=strategy
+            info["adaptation_id"], x_query, ctx=ctx, strategy=strategy,
+            tenant=tenant,
         )
         if ctx is not None:
             # adapt() stamped an adapt-hop-only breakdown into info; the
@@ -994,6 +1185,20 @@ class ServingFrontend:
             },
             "uptime_s": round(time.monotonic() - self._started, 1),
         }
+        if self.engine.registry is not None:
+            # the multi-tenant surface (ISSUE: registry + pager + quotas +
+            # per-tenant tallies) under one scrape-able block — obs_top's
+            # live tenant row and obs_report's tenant table read this
+            tenants_block: Dict[str, Any] = {
+                "registry": self.engine.registry.stats(),
+                "by_tenant": self.tenant_stats(),
+            }
+            pager = self.pool.pager_stats()
+            if pager is not None:
+                tenants_block["pager"] = pager
+            if self.quotas is not None and self.quotas.enabled:
+                tenants_block["quotas"] = self.quotas.stats()
+            out["tenants"] = tenants_block
         with self._drain_lock:
             out["drain"] = {
                 "draining": self._draining,
@@ -1176,20 +1381,22 @@ class _Handler(BaseHTTPRequestHandler):
                 # be misparsed as the client's next request
                 req = self._read_json()
                 frontend.engine.injector.fire("serving.http")
-                # optional per-request strategy (core/strategies.py): absent
-                # = the deployment default; unknown name => ValueError =>
-                # the 400 branch below — the wire contract for a typo'd tier
+                # optional per-request strategy (core/strategies.py) and
+                # tenant (serving/tenancy.py): absent = the deployment
+                # default; unknown name => ValueError => the 400 branch
+                # below — the wire contract for a typo'd tier or tenant
                 strategy = req.get("strategy")
+                tenant = req.get("tenant")
                 if self.path == "/adapt":
                     out = frontend.adapt(
                         req["x_support"], req["y_support"], ctx=ctx,
-                        strategy=strategy,
+                        strategy=strategy, tenant=tenant,
                     )
                     self._send_json(200, out)
                 elif self.path == "/predict":
                     probs = frontend.predict(
                         req["adaptation_id"], req["x_query"], ctx=ctx,
-                        strategy=strategy,
+                        strategy=strategy, tenant=tenant,
                     )
                     body = {"probs": probs.tolist()}
                     if ctx is not None:
@@ -1199,7 +1406,7 @@ class _Handler(BaseHTTPRequestHandler):
                 elif self.path == "/adapt_predict":
                     out = frontend.adapt_predict(
                         req["x_support"], req["y_support"], req["x_query"],
-                        ctx=ctx, strategy=strategy,
+                        ctx=ctx, strategy=strategy, tenant=tenant,
                     )
                     out["probs"] = out["probs"].tolist()
                     self._send_json(200, out)

@@ -64,6 +64,7 @@ class SessionStore:
         ttl_s: float,
         wall_clock: Callable[[], float] = time.time,
         strategy: str = "maml++",
+        tenant: Optional[str] = None,
     ) -> str:
         """Write one session (its adapted-parameter pytree) atomically,
         digest-wrapped. ``age_s`` is how long the entry had already lived in
@@ -71,19 +72,25 @@ class SessionStore:
         ORIGINAL expiry across the restart. ``strategy`` is the adaptation
         strategy the tree belongs to (core/strategies.py) — the rehydrating
         cache keys on it, so a session can only ever be served back through
-        the strategy that produced it."""
+        the strategy that produced it. ``tenant`` (serving/tenancy.py) is
+        recorded the same way for non-default tenants; the entry's
+        ``fingerprint`` is already the TENANT's checkpoint fingerprint, so
+        rehydration re-keys it under the right master by construction."""
         os.makedirs(self.root, exist_ok=True)
-        body = serialization.msgpack_serialize(
-            {
-                "digest": str(digest),
-                "fingerprint": str(fingerprint),
-                "strategy": str(strategy),
-                "saved_at": float(wall_clock()),
-                "age_s": float(age_s),
-                "ttl_s": float(ttl_s),
-                "tree": serialization.to_bytes(jax.tree.map(np.asarray, tree)),
-            }
-        )
+        payload = {
+            "digest": str(digest),
+            "fingerprint": str(fingerprint),
+            "strategy": str(strategy),
+            "saved_at": float(wall_clock()),
+            "age_s": float(age_s),
+            "ttl_s": float(ttl_s),
+            "tree": serialization.to_bytes(jax.tree.map(np.asarray, tree)),
+        }
+        if tenant:
+            # only non-default tenants stamp the field: a default-tenant
+            # spill stays byte-compatible with pre-tenancy readers
+            payload["tenant"] = str(tenant)
+        body = serialization.msgpack_serialize(payload)
         blob = serialization.msgpack_serialize(
             {
                 "format": SESSION_FORMAT,
@@ -102,20 +109,27 @@ class SessionStore:
         fingerprint: str,
         template: Any,
         wall_clock: Callable[[], float] = time.time,
-    ) -> Tuple[List[Tuple[str, Any, float, str]], Dict[str, int]]:
-        """-> (``[(digest, tree, lived_s, strategy)]`` safe to serve, stats).
-        Digest-verified; corrupt => quarantined ``*.corrupt``; TTL-lapsed
-        => removed and counted ``stale``; other-checkpoint entries counted
-        ``foreign`` and left for a replica of that checkpoint. ``lived_s``
-        is how much TTL budget the session has already consumed (cache age
-        before spill + wall time parked on disk) — the rehydrating cache
-        back-dates the entry with it, so a restart never extends a
-        session's original expiry. ``strategy`` is the adaptation strategy
-        recorded at spill (files from before the registry read as the
-        default). Loaded files are consumed (removed) — they are live cache
-        entries again."""
+        tenant_fingerprints: Optional[Dict[str, str]] = None,
+    ) -> Tuple[List[Tuple[str, Any, float, str, Optional[str]]], Dict[str, int]]:
+        """-> (``[(digest, tree, lived_s, strategy, tenant)]`` safe to
+        serve, stats). Digest-verified; corrupt => quarantined ``*.corrupt``;
+        TTL-lapsed => removed and counted ``stale``; other-checkpoint
+        entries counted ``foreign`` and left for a replica of that
+        checkpoint. ``lived_s`` is how much TTL budget the session has
+        already consumed (cache age before spill + wall time parked on
+        disk) — the rehydrating cache back-dates the entry with it, so a
+        restart never extends a session's original expiry. ``strategy`` is
+        the adaptation strategy recorded at spill (files from before the
+        registry read as the default); ``tenant`` likewise (pre-tenancy
+        files read as the default tenant, None). ``tenant_fingerprints``
+        maps tenant id -> checkpoint fingerprint for the tenants this fleet
+        serves (serving/registry.py): a spilled tenant session rehydrates
+        only when BOTH its recorded tenant is registered AND its
+        fingerprint matches that tenant's checkpoint — anything else stays
+        ``foreign``, never a cross-tenant serve. Loaded files are consumed
+        (removed) — they are live cache entries again."""
         stats = {"loaded": 0, "stale": 0, "corrupt": 0, "foreign": 0}
-        entries: List[Tuple[str, Any, float, str]] = []
+        entries: List[Tuple[str, Any, float, str, Optional[str]]] = []
         if not os.path.isdir(self.root):
             return entries, stats
         for name in sorted(os.listdir(self.root)):
@@ -129,7 +143,12 @@ class SessionStore:
                 os.replace(path, path + ".corrupt")
                 stats["corrupt"] += 1
                 continue
-            if payload["fingerprint"] != fingerprint:
+            tenant = payload.get("tenant") or None
+            if tenant is None:
+                expected = fingerprint
+            else:
+                expected = (tenant_fingerprints or {}).get(str(tenant))
+            if expected is None or payload["fingerprint"] != expected:
                 stats["foreign"] += 1
                 continue
             ttl_s = float(payload["ttl_s"])
@@ -148,7 +167,8 @@ class SessionStore:
                 continue
             entries.append(
                 (payload["digest"], tree, lived_s,
-                 str(payload.get("strategy", "maml++")))
+                 str(payload.get("strategy", "maml++")),
+                 str(tenant) if tenant is not None else None)
             )
             stats["loaded"] += 1
             os.remove(path)

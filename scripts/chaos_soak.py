@@ -2,7 +2,7 @@
 """Seeded chaos-soak campaign over the resilience subsystem.
 
 Usage:
-    python scripts/chaos_soak.py --episodes 16 --seed 0 [--work-dir DIR]
+    python scripts/chaos_soak.py --episodes 17 --seed 0 [--work-dir DIR]
         [--no-subprocess]
 
 Samples fault injections across every registered seam (checkpoint
@@ -59,7 +59,7 @@ setup_compilation_cache(test_tuning=True)
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("--episodes", type=int, default=16)
+    parser.add_argument("--episodes", type=int, default=17)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--work-dir",

@@ -45,6 +45,24 @@ def _parse_stairs(text: str):
     return stairs
 
 
+def _parse_tenant_skew(text: str, n_tenants: int):
+    """'uniform' -> None (equal weights); 'zipf:a' -> 1/rank^a weights.
+    Zipf is the realistic multi-tenant shape: a few hot tenants pin
+    residency, a long cold tail exercises the pager."""
+    if n_tenants <= 0 or text == "uniform":
+        return None
+    if text.startswith("zipf:"):
+        try:
+            a = float(text.split(":", 1)[1])
+        except ValueError:
+            a = -1.0
+        if a >= 0:
+            return [1.0 / (i + 1) ** a for i in range(n_tenants)]
+    raise SystemExit(
+        f"loadgen: --tenant-skew must be 'uniform' or 'zipf:a', got {text!r}"
+    )
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--seed", type=int, default=0)
@@ -93,8 +111,24 @@ def main(argv=None) -> int:
         help="emit the request schedule as one JSON line and exit "
         "(no backend contact; the determinism-check surface)",
     )
+    parser.add_argument(
+        "--tenants", type=int, default=0,
+        help="number of tenants (t0..tN-1) to spread traffic across; 0 = "
+        "single-tenant. Without --run-dir/--url, N perturbed tenant "
+        "checkpoints are synthesized behind an in-process registry.",
+    )
+    parser.add_argument(
+        "--tenant-skew", default="uniform",
+        help="tenant traffic skew: 'uniform' or 'zipf:a' (weight of the "
+        "i-th tenant proportional to 1/(i+1)^a; same --seed => "
+        "bit-identical tenant assignment)",
+    )
     args = parser.parse_args(argv)
     stairs = _parse_stairs(args.stairs)
+    if args.tenants < 0:
+        raise SystemExit(f"loadgen: --tenants must be >= 0, got {args.tenants}")
+    tenants = [f"t{i}" for i in range(args.tenants)] or None
+    tenant_weights = _parse_tenant_skew(args.tenant_skew, args.tenants)
     if args.url and args.run_dir:
         # an external-process target serves ITS OWN checkpoint; a local
         # run dir cannot also be the backend — refuse instead of guessing
@@ -112,6 +146,8 @@ def main(argv=None) -> int:
         adapt_frac=args.adapt_frac,
         query_sizes=query_sizes,
         query_weights=query_weights,
+        tenants=tenants,
+        tenant_weights=tenant_weights,
     )
     if not schedule:
         # fail fast BEFORE the backend spins up: heavy-tailed gaps over a
@@ -125,7 +161,16 @@ def main(argv=None) -> int:
         print(
             json.dumps(
                 {
-                    "schedule": [dataclasses.asdict(r) for r in schedule],
+                    # drop the all-None tenant column from single-tenant
+                    # schedules: pre-tenancy seeds keep byte-identical output
+                    "schedule": [
+                        {
+                            k: v
+                            for k, v in dataclasses.asdict(r).items()
+                            if k != "tenant" or v is not None
+                        }
+                        for r in schedule
+                    ],
                     "digest": slo.schedule_digest(schedule),
                 }
             ),
@@ -182,12 +227,35 @@ def main(argv=None) -> int:
             cfg,
             model=build_vgg(img, n_way, num_stages=stages, cnn_num_filters=filters),
         )
+        state = system.init_train_state()
+        registry = None
+        if tenants:
+            import tempfile
+
+            from howtotrainyourmamlpytorch_tpu.serving.registry import (
+                synthetic_registry,
+            )
+
+            registry = synthetic_registry(
+                tenants, state,
+                tempfile.mkdtemp(prefix="loadgen_tenants_"), args.seed,
+            )
         frontend = ServingFrontend(
-            AdaptationEngine(system, system.init_train_state()),
+            AdaptationEngine(system, state, registry=registry),
             access_log_dir=args.access_log_dir or None,
             replicas=args.replicas,
         )
         model_label = f"vgg{stages}x{filters}"
+    if tenants and (args.run_dir or args.url):
+        # the target owns its registry; with --run-dir we can verify the
+        # schedule's tenant ids are actually registered before offering load
+        reg = getattr(getattr(frontend, "engine", None), "registry", None)
+        missing = [t for t in tenants if reg is None or t not in reg]
+        if args.run_dir and missing:
+            raise SystemExit(
+                f"loadgen: --tenants {args.tenants} needs tenants "
+                f"{missing} in the run dir's tenant registry (tenants.yaml)"
+            )
     img_shape = cfg.image_shape if args.run_dir else (28, 28, 1)
     n_replicas = len(frontend.pool) if getattr(frontend, "pool", None) else None
 
@@ -245,6 +313,21 @@ def main(argv=None) -> int:
         **(
             {"target": args.url, "per_backend": frontend.per_backend()}
             if args.url
+            else {}
+        ),
+        # multi-tenant runs carry the paging story next to the latency one
+        **(
+            {
+                "tenants": args.tenants,
+                "tenant_skew": args.tenant_skew,
+                **(
+                    {"pager": frontend.pool.pager_stats()}
+                    if getattr(frontend, "pool", None) is not None
+                    and frontend.pool.pager_stats() is not None
+                    else {}
+                ),
+            }
+            if args.tenants
             else {}
         ),
     )

@@ -329,6 +329,7 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
 
     # events.jsonl: counts by name + the resilience-notable subset
     events_path = os.path.join(logs_dir, "events.jsonl")
+    event_records: List[Dict[str, Any]] = []
     if os.path.exists(events_path):
         event_records, torn_events = _read_jsonl(events_path)
         if torn_events:
@@ -360,13 +361,14 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
                 for k in ("ts", "event", "replica", "backend", "reason",
                           "status", "routable", "count", "deadline_exceeded",
                           "spilled_sessions", "loaded", "stale", "corrupt",
-                          "in_count")
+                          "in_count", "tenant", "bytes")
                 if rec.get(k) is not None
             }
             for rec in event_records
             if rec.get("event")
             in ("replica_death", "backend_out", "backend_in", "drain_begin",
-                "drain_complete", "sessions_spilled", "sessions_rehydrated")
+                "drain_complete", "sessions_spilled", "sessions_rehydrated",
+                "tenant_evicted")
         ]
         if serving_events:
             report["serving_events"] = serving_events
@@ -406,6 +408,9 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
         strategies = _strategies_from_access(access_records)
         if strategies is not None:
             report["strategies"] = strategies
+        tenants = _tenants_from_access(access_records, event_records)
+        if tenants is not None:
+            report["tenants"] = tenants
 
     xplane_dir = xplane_dir or _profile_dir_from_config(run_dir)
     breakdown = _device_breakdown(xplane_dir)
@@ -480,6 +485,69 @@ def _strategies_from_access(
         vals.sort()
         per[strategy]["p50_ms"] = round(vals[len(vals) // 2], 3)
         per[strategy]["p95_ms"] = round(vals[min(len(vals) - 1, int(len(vals) * 0.95))], 3)
+    return dict(sorted(per.items()))
+
+
+def _tenants_from_access(
+    records: List[Dict[str, Any]],
+    events: List[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """Per-tenant latency/outcome/resident-bytes table. Request rows come
+    off access-log lines (lines without a tenant field — single-tenant
+    deployments, HTTP-layer failures — count under ``default``); paging
+    rows replay the pager's ``tenant_paged_in``/``tenant_evicted`` events
+    so end-of-run master resident bytes are answerable from the run dir.
+    Returns None for runs with no tenant traffic and no paging at all."""
+    per: Dict[str, Dict[str, Any]] = {}
+    latencies: Dict[str, List[float]] = {}
+    saw_tenant_field = False
+
+    def _row(tenant: str) -> Dict[str, Any]:
+        return per.setdefault(
+            tenant,
+            {"requests": 0, "by_verb": {}, "by_outcome": {},
+             "page_ins": 0, "evictions": 0, "resident_bytes": 0},
+        )
+
+    for r in records:
+        if not isinstance(r.get("verb"), str):
+            continue
+        tenant = r.get("tenant")
+        if isinstance(tenant, str):
+            saw_tenant_field = True
+        else:
+            tenant = "default"
+        row = _row(tenant)
+        row["requests"] += 1
+        verb, outcome = str(r.get("verb")), str(r.get("outcome"))
+        row["by_verb"][verb] = row["by_verb"].get(verb, 0) + 1
+        row["by_outcome"][outcome] = row["by_outcome"].get(outcome, 0) + 1
+        total_ms = r.get("total_ms")
+        if isinstance(total_ms, (int, float)):
+            latencies.setdefault(tenant, []).append(float(total_ms))
+    saw_paging = False
+    for e in events:
+        tenant, nbytes = e.get("tenant"), e.get("bytes")
+        if not isinstance(tenant, str) or not isinstance(nbytes, int):
+            continue
+        if e.get("event") == "tenant_paged_in":
+            saw_paging = True
+            row = _row(tenant)
+            row["page_ins"] += 1
+            row["resident_bytes"] += nbytes
+        elif e.get("event") == "tenant_evicted":
+            saw_paging = True
+            row = _row(tenant)
+            row["evictions"] += 1
+            row["resident_bytes"] = max(0, row["resident_bytes"] - nbytes)
+    if not saw_tenant_field and not saw_paging:
+        return None
+    for tenant, vals in latencies.items():
+        vals.sort()
+        per[tenant]["p50_ms"] = round(vals[len(vals) // 2], 3)
+        per[tenant]["p95_ms"] = round(
+            vals[min(len(vals) - 1, int(len(vals) * 0.95))], 3
+        )
     return dict(sorted(per.items()))
 
 
@@ -751,6 +819,23 @@ def render_human(report: Dict[str, Any]) -> str:
                 f"{name[:12]:<12} {row['requests']:>8} "
                 f"{row.get('p50_ms', '-'):>8} {row.get('p95_ms', '-'):>8} "
                 f"{outcomes}"
+            )
+    tenants = report.get("tenants")
+    if tenants:
+        lines.append("-- serving tenants (access.jsonl + events.jsonl) --")
+        lines.append(
+            f"{'tenant':<16} {'requests':>8} {'p50_ms':>8} {'p95_ms':>8} "
+            f"{'page_ins':>8} {'evict':>6} {'res_bytes':>10}  {'outcomes'}"
+        )
+        for name, row in tenants.items():
+            outcomes = ",".join(
+                f"{k}={v}" for k, v in sorted(row["by_outcome"].items())
+            )
+            lines.append(
+                f"{name[:16]:<16} {row['requests']:>8} "
+                f"{row.get('p50_ms', '-'):>8} {row.get('p95_ms', '-'):>8} "
+                f"{row['page_ins']:>8} {row['evictions']:>6} "
+                f"{row['resident_bytes']:>10}  {outcomes}"
             )
     hbm = report.get("hbm")
     if hbm:

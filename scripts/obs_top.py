@@ -162,6 +162,34 @@ def serving_frame(
         frame["_strategy_requests"] = {
             name: row["requests"] for name, row in mix.items()
         }
+    # live tenant mix (serving/server.py tenants block): per-tenant request
+    # totals plus the pager's paging/eviction picture — "which tenant is
+    # eating the fleet, and is the weight pager thrashing" at a glance
+    tenants = metrics.get("tenants")
+    if isinstance(tenants, dict) and tenants:
+        prev_mix = (prev or {}).get("_tenant_requests") or {}
+        by_tenant = tenants.get("by_tenant") or {}
+        mix = {}
+        for name, row in by_tenant.items():
+            if not isinstance(row, dict):
+                continue
+            total = row.get("requests", 0)
+            mix[name] = {
+                "requests": total,
+                "delta": max(0, total - prev_mix.get(name, 0)),
+                "ok": sum(v for k, v in row.items() if k.endswith(".ok")),
+            }
+        frame["tenant_mix"] = mix
+        frame["_tenant_requests"] = {
+            name: row["requests"] for name, row in mix.items()
+        }
+        pager = tenants.get("pager")
+        if isinstance(pager, dict):
+            frame["tenant_pager"] = {
+                k: pager.get(k)
+                for k in ("resident", "resident_bytes", "page_ins",
+                          "evictions", "page_in_p50_ms")
+            }
     # fleet payloads (serving/pool.py): the router verdicts + one compact
     # row per replica — which failure domain is hot, dead, or tripping
     router = metrics.get("router")
@@ -334,6 +362,24 @@ def render(frame: Dict[str, Any]) -> str:
                 for name, row in sorted(mix.items())
             )
             lines.append(f"strategy {parts}")
+        tmix = frame.get("tenant_mix")
+        if tmix:
+            total = sum(row["requests"] for row in tmix.values()) or 1
+            parts = "  ".join(
+                f"{name} {row['requests']} "
+                f"({100 * row['requests'] // total}%, +{row['delta']})"
+                for name, row in sorted(tmix.items())
+            )
+            lines.append(f"tenant   {parts}")
+        pager = frame.get("tenant_pager")
+        if pager:
+            lines.append(
+                f"pager    resident {_fmt(pager['resident'])} "
+                f"({_fmt(pager['resident_bytes'])} B)   "
+                f"page_ins {_fmt(pager['page_ins'])} "
+                f"(p50 {_fmt(pager['page_in_p50_ms'])} ms)   "
+                f"evictions {_fmt(pager['evictions'])}"
+            )
         router = frame.get("router")
         if router:
             lines.append(

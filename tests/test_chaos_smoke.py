@@ -40,12 +40,12 @@ def test_episode_sampling_is_seeded_and_covers_every_seam():
         "checkpoint.write", "serving.dispatch", "serving.http",
     }
     # deterministic in seed; jittered across seeds
-    a = [e.kind for e in sample_episodes(7, 16)]
-    b = [e.kind for e in sample_episodes(7, 16)]
+    a = [e.kind for e in sample_episodes(7, 17)]
+    b = [e.kind for e in sample_episodes(7, 17)]
     assert a == b
-    assert len(sample_episodes(0, 16, include_subprocess=False)) == 16
+    assert len(sample_episodes(0, 17, include_subprocess=False)) == 17
     assert not any(
-        e.subprocess for e in sample_episodes(0, 16, include_subprocess=False)
+        e.subprocess for e in sample_episodes(0, 17, include_subprocess=False)
     )
 
 
@@ -73,7 +73,7 @@ def test_chaos_smoke_campaign_all_invariants_green(toy_dataset, tmp_path):
 
 @pytest.mark.slow
 def test_full_chaos_soak_cli(tmp_path):
-    """The acceptance command: ``python scripts/chaos_soak.py --episodes 16
+    """The acceptance command: ``python scripts/chaos_soak.py --episodes 17
     --seed 0`` (one full menu pass, including the ISSUE 6 grow-back /
     SIGTERM-during-async-save episodes, the ISSUE 11 replica-death episode,
     and the ISSUE 14 cross-process gateway drills) reports every invariant
@@ -81,7 +81,7 @@ def test_full_chaos_soak_cli(tmp_path):
     proc = subprocess.run(
         [
             sys.executable, "scripts/chaos_soak.py",
-            "--episodes", "16", "--seed", "0",
+            "--episodes", "17", "--seed", "0",
             "--work-dir", str(tmp_path),
         ],
         cwd=REPO,
@@ -94,11 +94,11 @@ def test_full_chaos_soak_cli(tmp_path):
     assert len(lines) == 1, lines
     verdict = json.loads(lines[0])
     assert verdict["ok"] is True
-    assert verdict["episodes"] == 16
+    assert verdict["episodes"] == 17
     assert verdict["violations"] == []
     kinds = {r["kind"] for r in verdict["episode_results"]}
     assert {
         "device-grow-resume", "sigterm-during-async-save",
-        "serve-replica-death", "gateway-kill9-backend",
+        "serve-replica-death", "serve-tenant-thrash", "gateway-kill9-backend",
         "gateway-drain-rehydrate", "gateway-rolling-restart",
     } <= kinds

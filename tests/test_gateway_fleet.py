@@ -558,7 +558,7 @@ def test_session_store_verdicts_corrupt_stale_foreign(tmp_path, drain_setup):
     assert store.pending() == 4
     entries, stats = store.load_all("fp-A", template=engine.state.params)
     assert stats == {"loaded": 1, "stale": 1, "corrupt": 1, "foreign": 1}
-    assert [d for d, _, _, _ in entries] == ["d" * 64]
+    assert [d for d, _, _, _, _ in entries] == ["d" * 64]
     # pre-registry spill (no strategy kwarg) reads back as the default
     assert entries[0][3] == "maml++"
     # lived_s reports the TTL budget already consumed (cache age at spill +

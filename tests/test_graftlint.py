@@ -937,6 +937,41 @@ def test_self_gate_covers_strategy_registry_paths_explicitly():
     )
 
 
+def test_self_gate_covers_tenancy_paths_explicitly():
+    """The multi-tenant platform (ISSUE 16) sits inside the self-gate on
+    its own terms: the pager and quotas guard shared counters under locks
+    (GL201 territory) and run on the dispatch path, and the registry does
+    lazy cross-thread loads — zero unsuppressed findings even if the
+    top-level path list is ever restructured."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "tenancy.py"
+                ),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "registry.py"
+                ),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "sessions.py"
+                ),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "cache.py"
+                ),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "server.py"
+                ),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in tenancy paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_catches_an_introduced_true_positive(tmp_path):
     """End-to-end: drop one fixture true positive next to real package code
     and the CLI must exit 1 with a GL id on stdout."""

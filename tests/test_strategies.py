@@ -586,7 +586,8 @@ def test_session_store_round_trips_strategy(tmp_path):
     store.spill("d1", tree, "fp", age_s=1.0, ttl_s=600.0, strategy="anil")
     entries, stats = store.load_all(fingerprint="fp", template=tree)
     assert stats["loaded"] == 1
-    digest, loaded, lived_s, strategy = entries[0]
+    digest, loaded, lived_s, strategy, tenant = entries[0]
+    assert tenant is None
     assert digest == "d1" and strategy == "anil"
     np.testing.assert_array_equal(loaded["fc"]["w"], tree["fc"]["w"])
 

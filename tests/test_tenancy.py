@@ -1,0 +1,463 @@
+"""Multi-tenant adaptation platform (``serving/registry.py`` +
+``serving/tenancy.py``): registry manifest round-trip and lazy host loads,
+LRU/watermark paging arithmetic, default-tenant digest stability, quota
+429s with honest Retry-After, session spill/rehydrate carrying tenants,
+and the tier-1 platform drill — 4 tenants behind one fleet under a budget
+fitting 2, cold tenants served via page-in with ZERO outside-prewarm
+compiles, bit-identical to single-tenant controls, quota breaches shed
+without degrading anyone else."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from howtotrainyourmamlpytorch_tpu.config import Config, ServingConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+from howtotrainyourmamlpytorch_tpu.serving import AdaptationEngine, ServingFrontend
+from howtotrainyourmamlpytorch_tpu.serving.cache import support_digest, tree_bytes
+from howtotrainyourmamlpytorch_tpu.serving.errors import (
+    ServiceUnavailableError,
+    UnknownAdaptationError,
+)
+from howtotrainyourmamlpytorch_tpu.serving.registry import (
+    TenantRegistry,
+    synthetic_registry,
+)
+from howtotrainyourmamlpytorch_tpu.serving.sessions import SessionStore
+from howtotrainyourmamlpytorch_tpu.serving.tenancy import (
+    QuotaExceededError,
+    TenantQuotas,
+    WeightPager,
+    normalize_tenant,
+    validate_request_tenant,
+)
+
+_IMG = (14, 14, 1)
+
+
+def _config(**kw):
+    serving = kw.pop("serving", None)
+    base = dict(
+        num_classes_per_set=5,
+        num_samples_per_class=2,
+        num_target_samples=3,
+        batch_size=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        total_iter_per_epoch=4,
+    )
+    base.update(kw)
+    if serving is not None:
+        base["serving"] = serving
+    return Config(**base)
+
+
+def _system(cfg):
+    return MAMLSystem(
+        cfg,
+        model=build_vgg(
+            _IMG, cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4
+        ),
+    )
+
+
+def _episode(seed=0):
+    b = synthetic_batch(1, 5, 2, 3, _IMG, seed=seed)
+    return (
+        b["x_support"][0],
+        b["y_support"][0],
+        b["x_target"][0].reshape((-1,) + _IMG),
+    )
+
+
+# ---------------------------------------------------------------------------
+# registry: manifest round-trip + lazy host loads
+# ---------------------------------------------------------------------------
+
+
+def test_registry_yaml_round_trip_and_discovery_precedence(tmp_path):
+    reg_path = tmp_path / "tenants.yaml"
+    reg_path.write_text(
+        "tenants:\n"
+        "  acme: {run_dir: acme_runs, checkpoint: latest}\n"
+        "  bravo: {run_dir: /abs/bravo}\n"
+    )
+    reg = TenantRegistry.from_yaml(str(reg_path))
+    assert reg.tenants() == ("acme", "bravo")
+    assert "acme" in reg and "nobody" not in reg
+    # relative run_dirs resolve against the registry file's directory
+    assert reg._resolve_run_dir("acme") == str(tmp_path / "acme_runs")
+    assert reg._resolve_run_dir("bravo") == "/abs/bravo"
+    # checkpoint defaults to "best"
+    assert reg._entries["bravo"]["checkpoint"] == "best"
+
+    class _Cfg:
+        tenant_registry = str(reg_path)
+
+    # explicit path wins over <run_dir>/tenants.yaml; no source => None
+    assert TenantRegistry.discover(_Cfg(), run_dir="/nonexistent") is not None
+    _Cfg.tenant_registry = ""
+    assert TenantRegistry.discover(_Cfg(), run_dir=str(tmp_path)) is not None
+    assert TenantRegistry.discover(_Cfg(), run_dir="/nonexistent") is None
+
+    with pytest.raises(ValueError):
+        TenantRegistry({"acme": "not-a-mapping"})
+    with pytest.raises(ValueError):
+        TenantRegistry({})
+
+
+def test_registry_loads_masters_lazily_and_once(tmp_path):
+    cfg = _config()
+    system = _system(cfg)
+    state = system.init_train_state()
+    reg = synthetic_registry(["a", "b"], state, str(tmp_path))
+    # naming tenants costs nothing until traffic arrives for one
+    assert reg.stats() == {"tenants": 2, "hosted": 0, "loads": 0}
+    assert reg.hosted_fingerprints() == {}
+    st_a, fp_a = reg.host_state("a")
+    assert reg.stats()["loads"] == 1 and reg.stats()["hosted"] == 1
+    # cached: a second ask is NOT a second disk load
+    st_a2, fp_a2 = reg.host_state("a")
+    assert reg.stats()["loads"] == 1 and fp_a2 == fp_a
+    assert reg.fingerprint("b") != fp_a  # distinct perturbed checkpoints
+    assert reg.hosted_fingerprints() == {"a": fp_a, "b": reg.fingerprint("b")}
+    with pytest.raises(KeyError):
+        reg.host_state("nobody")
+
+
+def test_registry_rejects_structurally_foreign_checkpoints(tmp_path):
+    cfg = _config()
+    system = _system(cfg)
+    reg = synthetic_registry(["a"], system.init_train_state(), str(tmp_path))
+    # a wider model cannot share the fleet's shape-keyed programs
+    wide = MAMLSystem(
+        cfg, model=build_vgg(_IMG, 5, num_stages=2, cnn_num_filters=8)
+    )
+    reg.template = wide.init_train_state()
+    with pytest.raises(ValueError, match="structure differs"):
+        reg.host_state("a")
+
+
+# ---------------------------------------------------------------------------
+# normalization + pager arithmetic (fake registry, fake byte budget)
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_normalization_and_validation():
+    assert normalize_tenant(None) is None
+    assert normalize_tenant("") is None
+    assert normalize_tenant("default") is None
+    assert normalize_tenant(" acme ") == "acme"
+    with pytest.raises(ValueError):
+        normalize_tenant(7)
+    assert validate_request_tenant("default", None) is None
+    with pytest.raises(ValueError, match="no tenant registry"):
+        validate_request_tenant("acme", None)
+
+    class _Reg:
+        def __contains__(self, t):
+            return t == "acme"
+
+        def tenants(self):
+            return ("acme",)
+
+    assert validate_request_tenant("acme", _Reg()) == "acme"
+    with pytest.raises(ValueError, match="unknown tenant"):
+        validate_request_tenant("bravo", _Reg())
+
+
+class _FakeRegistry:
+    """Registry double: each tenant's 'master' is one float32 vector of
+    ``leaf_n`` elements (4*leaf_n bytes once device-resident)."""
+
+    def __init__(self, tenants, leaf_n=256):
+        self._tenants = list(tenants)
+        self.leaf_n = leaf_n
+
+    def host_state(self, tenant):
+        i = self._tenants.index(tenant)
+        return (
+            {"w": np.full((self.leaf_n,), float(i), np.float32)},
+            f"fp-{tenant}",
+        )
+
+
+def test_pager_lru_eviction_arithmetic_under_byte_budget():
+    per = 256 * 4
+    pager = WeightPager(
+        _FakeRegistry(["a", "b", "c"]), template={"w": np.zeros(1)},
+        budget_bytes=2 * per,
+    )
+    pager.resident("a")
+    pager.resident("b")
+    assert pager.stats()["resident_bytes"] == 2 * per
+    assert pager.stats()["resident_tenants"] == ["a", "b"]
+    # touching "a" refreshes its recency: paging "c" in evicts "b", not "a"
+    pager.resident("a")
+    pager.resident("c")
+    st = pager.stats()
+    assert st["resident_tenants"] == ["a", "c"]
+    assert st["evictions"] == 1 and st["page_ins"] == 3
+    assert st["resident_bytes"] == 2 * per
+    assert st["page_in_p50_ms"] is not None
+    # a re-request of the evicted tenant is a page-in, never an error
+    np.testing.assert_array_equal(
+        np.asarray(pager.resident("b")["w"])[:1], [1.0]
+    )
+    # drained events tell the whole story with honest byte counts
+    events = pager.drain_events()
+    assert [e["event"] for e in events].count("tenant_evicted") == 2
+    assert all(e["bytes"] == per for e in events)
+    assert pager.drain_events() == []  # drained means drained
+    # the default tenant is the pinned template: no paging, no accounting
+    assert pager.resident(None) is pager.template
+    assert pager.stats()["page_ins"] == 4
+
+
+def test_pager_watermark_pressure_evicts_lru():
+    class _Watermarks:
+        headroom = 1.0
+
+        def snapshot(self):
+            return {"headroom_frac_min": self.headroom}
+
+    wm = _Watermarks()
+    pager = WeightPager(
+        _FakeRegistry(["a", "b"]), template=None,
+        min_headroom_frac=0.1, watermarks=wm,
+    )
+    pager.resident("a")
+    pager.resident("b")
+    assert pager.check_watermark() is None  # plenty of headroom
+    wm.headroom = 0.05
+    assert pager.check_watermark() == "a"  # LRU goes first
+    assert pager.stats()["resident_tenants"] == ["b"]
+    drained = pager.drain_events()
+    assert drained[-1]["reason"] == "hbm_watermark"
+    # no provider / knob off => free no-op
+    assert WeightPager(_FakeRegistry(["a"]), None).check_watermark() is None
+
+
+# ---------------------------------------------------------------------------
+# digest stability + quotas
+# ---------------------------------------------------------------------------
+
+
+def test_default_tenant_digest_stability_pin():
+    x, y, _ = _episode(3)
+    base = support_digest(x, y, 2)
+    # absent, None, and explicitly-default tenants are all byte-identical
+    # to the pre-tenancy digest — adaptation ids never churn on upgrade
+    assert support_digest(x, y, 2, tenant=None) == base
+    assert support_digest(x, y, 2, "maml++", None) == base
+    assert support_digest(x, y, 2, tenant="acme") != base
+    assert support_digest(x, y, 2, tenant="acme") != support_digest(
+        x, y, 2, tenant="bravo"
+    )
+
+
+def test_quotas_rate_inflight_and_resident_bytes():
+    now = [0.0]
+    q = TenantQuotas(
+        max_inflight=2, rate_rps=1.0, max_resident_bytes=100,
+        clock=lambda: now[0],
+    )
+    assert q.enabled
+    q.acquire("a")  # burst token
+    with pytest.raises(QuotaExceededError) as exc:
+        q.acquire("a")
+    assert exc.value.reason == "rate"
+    assert 0 < exc.value.retry_after_s <= 1.0  # honest token-refill time
+    # tenants do not share buckets: "b" is unaffected by "a"'s breach
+    q.acquire("b")
+    now[0] += 2.0  # refill
+    q.acquire("a")
+    now[0] += 2.0
+    with pytest.raises(QuotaExceededError) as exc:
+        q.acquire("a")  # 2 inflight already held
+    assert exc.value.reason == "inflight"
+    q.release("a")
+    now[0] += 2.0
+    q.acquire("a")  # freed slot admits again
+    q.check_resident_bytes("a", 100)  # at the limit is fine
+    with pytest.raises(QuotaExceededError):
+        q.check_resident_bytes("a", 101)
+    st = q.stats()
+    assert st["inflight"]["a"] == 2
+    assert st["rejections"] == {
+        "a.rate": 1, "a.inflight": 1, "a.resident_bytes": 1
+    }
+    assert not TenantQuotas().enabled  # all-zero = off
+
+
+# ---------------------------------------------------------------------------
+# sessions: spill/rehydrate carries the tenant
+# ---------------------------------------------------------------------------
+
+
+def test_session_spill_rehydrate_round_trips_tenant(tmp_path):
+    store = SessionStore(str(tmp_path))
+    tree = {"w": np.arange(4, dtype=np.float32)}
+    store.spill("d" * 64, tree, "fp-acme", 1.0, 600.0, tenant="acme")
+    store.spill("e" * 64, tree, "fleet-fp", 1.0, 600.0)
+    # without the tenant map, the tenant entry stays foreign (never served
+    # under the wrong master); the default entry rehydrates
+    entries, stats = store.load_all("fleet-fp", tree)
+    assert stats == {"loaded": 1, "stale": 0, "corrupt": 0, "foreign": 1}
+    assert [(e[0], e[4]) for e in entries] == [("e" * 64, None)]
+    # with the map, the tenant entry rehydrates carrying its tenant
+    entries, stats = store.load_all(
+        "fleet-fp", tree, tenant_fingerprints={"acme": "fp-acme"}
+    )
+    assert stats["loaded"] == 1
+    digest, loaded, lived_s, strategy, tenant = entries[0]
+    assert (digest, strategy, tenant) == ("d" * 64, "maml++", "acme")
+    np.testing.assert_array_equal(np.asarray(loaded["w"]), tree["w"])
+
+
+def test_pre_tenancy_session_files_read_as_default_tenant(tmp_path):
+    # a file spilled WITHOUT the tenant field (pre-tenancy writer) must
+    # read back as the default tenant and rehydrate against the fleet
+    # master — the upgrade story for spilled sessions
+    store = SessionStore(str(tmp_path))
+    tree = {"w": np.ones(2, np.float32)}
+    store.spill("a" * 64, tree, "fleet-fp", 0.0, 600.0, tenant=None)
+    entries, stats = store.load_all(
+        "fleet-fp", tree, tenant_fingerprints={"acme": "fp-acme"}
+    )
+    assert stats["loaded"] == 1
+    assert entries[0][4] is None
+    # a tenant whose fingerprint moved (re-finetuned checkpoint) stays
+    # foreign rather than serving stale weights
+    store.spill("b" * 64, tree, "fp-old", 0.0, 600.0, tenant="acme")
+    _, stats = store.load_all(
+        "fleet-fp", tree, tenant_fingerprints={"acme": "fp-new"}
+    )
+    assert stats["foreign"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 platform drill + quota isolation over the frontend
+# ---------------------------------------------------------------------------
+
+
+def test_platform_drill_tenant_thrash_all_invariants():
+    """The acceptance drill: 4 tenants (distinct toy checkpoints) behind
+    one fleet, budget fits 2 — cold tenants complete via page-in with zero
+    outside-prewarm compiles (sealed guard), responses bit-identical per
+    tenant to single-tenant controls, evictions/page-ins visible in
+    /metrics.tenants and events.jsonl, every non-200 access-resolvable."""
+    from howtotrainyourmamlpytorch_tpu.resilience.campaign import (
+        Episode,
+        _run_serve_episode,
+    )
+
+    violations = _run_serve_episode(
+        Episode(kind="serve-tenant-thrash", mode="serve")
+    )
+    assert violations == []
+
+
+@pytest.fixture(scope="module")
+def tenant_fleet(tmp_path_factory):
+    cfg = _config(
+        serving=ServingConfig(
+            support_buckets=[10], query_buckets=[15], max_batch_size=2
+        )
+    )
+    system = _system(cfg)
+    state = system.init_train_state()
+    registry = synthetic_registry(
+        ["acme", "bravo"], state,
+        str(tmp_path_factory.mktemp("tenant_fleet")),
+    )
+    frontend = ServingFrontend(
+        AdaptationEngine(system, state, registry=registry)
+    )
+    yield frontend
+    frontend.close()
+
+
+def test_quota_breach_sheds_429_without_degrading_others(tenant_fleet):
+    x, y, _ = _episode(11)
+    now = [0.0]
+    saved = tenant_fleet.quotas
+    # fake-clock quotas so the rate breach is deterministic (and so the
+    # 1 rps limit can't leak into later tests sharing the fleet)
+    tenant_fleet.quotas = TenantQuotas(rate_rps=1.0, clock=lambda: now[0])
+    try:
+        out = tenant_fleet.adapt(x, y, tenant="acme")
+        assert out["tenant"] == "acme"
+        # burst=1 token at 1 rps: the immediate second request is an
+        # honest 429 with a computed Retry-After, mapped onto the shed
+        # contract (quota admission runs BEFORE the cache check, so even
+        # this would-be cache hit consumes admission)
+        with pytest.raises(ServiceUnavailableError) as exc:
+            tenant_fleet.adapt(x, y, tenant="acme")
+        assert exc.value.status == 429
+        assert 0 < exc.value.retry_after_s <= 1.0
+        # the breach is acme's alone: bravo and the default tenant serve on
+        assert tenant_fleet.adapt(x, y, tenant="bravo")["tenant"] == "bravo"
+        assert "tenant" not in tenant_fleet.adapt(x, y)
+        m = tenant_fleet.metrics()
+        assert m["tenants"]["quotas"]["rejections"] == {"acme.rate": 1}
+        assert m["tenants"]["by_tenant"]["acme"]["adapt.shed"] == 1
+        assert m["tenants"]["by_tenant"]["acme"]["adapt.ok"] == 1
+        assert m["tenants"]["by_tenant"]["bravo"]["adapt.ok"] == 1
+        assert m["tenants"]["by_tenant"]["default"]["adapt.ok"] == 1
+        assert m["tenants"]["registry"]["hosted"] == 2
+    finally:
+        tenant_fleet.quotas = saved
+
+
+def test_cross_tenant_adaptation_id_is_honest_404(tenant_fleet):
+    # distinct masters => distinct fingerprints => the cache key for
+    # acme's id under bravo can never exist
+    x, y, xq = _episode(12)
+    acme_id = tenant_fleet.adapt(x, y, tenant="acme")["adaptation_id"]
+    probs = tenant_fleet.predict(acme_id, xq, tenant="acme")
+    assert probs.shape[0] == xq.shape[0]
+    with pytest.raises(UnknownAdaptationError):
+        tenant_fleet.predict(acme_id, xq, tenant="bravo")
+    with pytest.raises(UnknownAdaptationError):
+        tenant_fleet.predict(acme_id, xq)  # nor the default tenant's
+
+
+def test_engine_without_registry_rejects_tenant_traffic():
+    cfg = _config(
+        serving=ServingConfig(support_buckets=[10], query_buckets=[15])
+    )
+    system = _system(cfg)
+    engine = AdaptationEngine(system, system.init_train_state())
+    assert engine.registry is None and engine.pager is None
+    x, y, _ = _episode(13)
+    frontend = ServingFrontend(engine)
+    try:
+        with pytest.raises(ValueError, match="no tenant registry"):
+            frontend.adapt(x, y, tenant="acme")
+    finally:
+        frontend.close()
+
+
+def test_tenant_budget_bytes_flows_from_config(tmp_path):
+    cfg = _config(
+        serving=ServingConfig(
+            support_buckets=[10], query_buckets=[15],
+            tenant_budget_bytes=12345,
+        )
+    )
+    system = _system(cfg)
+    state = system.init_train_state()
+    registry = synthetic_registry(["a"], state, str(tmp_path))
+    engine = AdaptationEngine(system, state, registry=registry)
+    assert engine.pager is not None
+    assert engine.pager.budget_bytes == 12345
+    assert engine.pager.template is engine.state
+    # the template's own bytes never count against the budget
+    assert engine.pager.stats()["resident_bytes"] == 0
+    assert tree_bytes(engine.state) > 0
