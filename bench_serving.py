@@ -181,6 +181,23 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    # BENCH_REFINE, same rc-2 contract: N > 0 measures N guarded in-place
+    # session refinements through the frontend (refine_p50_ms + the guard's
+    # rollback count land in the line). "" / 0 = the stateless recipe
+    # exactly as before — refine_enabled stays off, so the planned program
+    # set and prewarm grid are untouched.
+    refine_knob = os.environ.get("BENCH_REFINE", "")
+    try:
+        refine_reps = int(refine_knob) if refine_knob else 0
+    except ValueError:
+        refine_reps = -1
+    if refine_reps < 0:
+        print(
+            f"bench_serving: bad BENCH_REFINE {refine_knob!r} "
+            "(want a non-negative integer: refine reps, 0 = off)",
+            file=sys.stderr,
+        )
+        return 2
     cfg = Config(
         num_classes_per_set=args.n_way,
         num_samples_per_class=args.k_shot,
@@ -194,6 +211,7 @@ def main(argv=None) -> int:
             # the benched strategy is the deployment's (only) configured
             # one: the prewarm grid, planned set, and default all follow
             strategies=[strategy],
+            refine_enabled=bool(refine_reps),
         ),
     )
     stages, filters = (2, 4) if args.tiny else (4, 64)
@@ -403,6 +421,31 @@ def main(argv=None) -> int:
         result["tenant_evictions"] = (
             pager_stats["evictions"] if pager_stats else None
         )
+        # guarded in-place refinement (BENCH_REFINE arm): adapt one session,
+        # warm-refine it once (the probe carve + baseline probe score settle
+        # outside the clock), then time steady-state refinements of the same
+        # support set. Rollbacks ride the frontend's honest counter; a
+        # quarantine exits via explicit re-adapt, timed like any rep.
+        if refine_reps:
+            from howtotrainyourmamlpytorch_tpu.serving.errors import (
+                SessionQuarantinedError,
+            )
+
+            x_rs, y_rs, _ = episode(400)
+            sid = frontend.adapt(x_rs, y_rs)["adaptation_id"]
+            frontend.refine(sid, x_rs, y_rs)
+            refine_ms = []
+            for _ in range(refine_reps):
+                t0 = time.perf_counter()
+                try:
+                    frontend.refine(sid, x_rs, y_rs)
+                except SessionQuarantinedError:
+                    sid = frontend.adapt(x_rs, y_rs)["adaptation_id"]
+                refine_ms.append((time.perf_counter() - t0) * 1e3)
+            result["refine_reps"] = refine_reps
+            result["refine_p50_ms"] = round(float(np.percentile(refine_ms, 50)), 3)
+            result["refine_p95_ms"] = round(float(np.percentile(refine_ms, 95)), 3)
+            result["rollbacks"] = int(frontend.counters.get("refine_rollbacks"))
     finally:
         frontend.close()
     device_kind = str(jax.devices()[0].device_kind)

@@ -482,6 +482,22 @@ def prewarm_serving(
             # the engine's ledger tag ("@r1" on fleet clones) keeps every
             # replica's rows distinct in merged prewarm/ledger tables
             name = f"serve_{kind}{tag}/{bucket}/{b}"
+        elif base == "refine":
+            # session refinement (engine._compiled_refine; planned only
+            # under serving.refine_enabled): adapt's support specs PLUS the
+            # stacked per-item fast weights the rollout starts from — never
+            # protonet-shaped, protonet refreshes reuse the adapt program
+            fn = engine._compiled_refine(bucket, b, strategy=strategy)
+            spec_key = ("params", b)
+            if spec_key not in fw_specs:
+                fw_specs[spec_key] = shape_specs(params, leading=(b,))
+            args = state_specs + (
+                fw_specs[spec_key],
+                _sds((b, bucket, h, w, c), np.float32),
+                _sds((b, bucket), np.int32),
+                _sds((b, bucket), np.float32),
+            )
+            name = f"serve_{kind}{tag}/{bucket}/{b}"
         else:  # predict: per-item fast weights stacked on the task axis
             fn = engine._compiled_predict(bucket, b, strategy=strategy)
             # the per-item fast-weight tree is strategy-shaped: a prototype

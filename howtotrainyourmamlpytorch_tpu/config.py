@@ -240,6 +240,29 @@ class ServingConfig:
     tenant_max_inflight: int = 0
     tenant_rate_rps: float = 0.0
     tenant_max_resident_bytes: int = 0
+    # Persistent adaptation sessions (serving/server.py::refine): an /adapt
+    # request naming a session_id with refine=true runs the K-step rollout
+    # FROM the session's cached fast weights instead of the masters —
+    # update-in-place refinement. Off by default: the refine program grid
+    # joins the planned sets / prewarm grid ONLY when enabled, so a
+    # refine-off deployment is byte-identical to the pre-session engine.
+    refine_enabled: bool = False
+    # Guard: after every refinement the session's held-out probe is scored
+    # (cross-entropy through the planned predict program). A non-finite
+    # score, or a score worse than the last-good by more than this
+    # tolerance, rolls the session back to its last-good fast weights.
+    refine_regress_tol: float = 0.5
+    # M consecutive rolled-back refinements quarantine the session: further
+    # refine/predict answer 409 + Retry-After until a fresh (non-refine)
+    # /adapt re-adapts it from the masters. Never silently-stale weights.
+    refine_quarantine_after: int = 3
+    # Bounded ring of previous last-good fast-weight snapshots kept per
+    # session (walked if the committed weights themselves go non-finite;
+    # also spilled with the session lineage across drains).
+    refine_snapshot_ring: int = 2
+    # Fraction of the FIRST refine request's support set held out as the
+    # session's persistent scoring probe (never trained on thereafter).
+    refine_holdout_frac: float = 0.25
 
     def __post_init__(self):
         self.support_buckets = sorted(int(b) for b in self.support_buckets)
@@ -304,6 +327,25 @@ class ServingConfig:
             raise ValueError(
                 f"tenant_max_resident_bytes must be >= 0 (0 = disabled), "
                 f"got {self.tenant_max_resident_bytes}"
+            )
+        if self.refine_regress_tol < 0:
+            raise ValueError(
+                f"refine_regress_tol must be >= 0, got {self.refine_regress_tol}"
+            )
+        if self.refine_quarantine_after < 1:
+            raise ValueError(
+                f"refine_quarantine_after must be >= 1, "
+                f"got {self.refine_quarantine_after}"
+            )
+        if self.refine_snapshot_ring < 1:
+            raise ValueError(
+                f"refine_snapshot_ring must be >= 1, "
+                f"got {self.refine_snapshot_ring}"
+            )
+        if not 0.0 < self.refine_holdout_frac < 1.0:
+            raise ValueError(
+                "refine_holdout_frac must be in (0, 1), "
+                f"got {self.refine_holdout_frac}"
             )
 
 

@@ -860,6 +860,65 @@ class MAMLSystem:
             support_weight=support_weight,
         )
 
+    def refine_fast_weights(
+        self,
+        state: TrainState,
+        fast_weights,
+        x_support,
+        y_support,
+        num_steps: Optional[int] = None,
+        support_weight=None,
+        strategy: Optional[str] = None,
+    ):
+        """Update-in-place refinement: the K-step rollout of
+        :meth:`adapt_fast_weights`, but started FROM a session's previously
+        adapted ``fast_weights`` instead of the masters — the serving-side
+        continual-adaptation primitive (ISSUE 17). Inner-optimizer hparams
+        and state are still derived from the masters (``state.params``): a
+        refinement is a fresh K-step episode over new support data, not a
+        continuation of the original optimizer trajectory, so every
+        refinement is governed by the same LSLR schedule the checkpoint was
+        trained with."""
+        cfg = self.cfg
+        strategy = self.strategy if strategy is None else strategy
+        if strategy == "protonet":
+            raise ValueError(
+                "protonet has no fast-weight rollout to refine — recompute "
+                "prototypes from the new support set via protonet_adapt"
+            )
+        if num_steps is None:
+            num_steps = cfg.number_of_evaluation_steps_per_iter
+        hparams = self._inner_hparams_for_rollout(state.inner_hparams, state.params)
+        inner_state = self._initial_inner_state(
+            state.params, hparams, state.opt_state
+        )
+        if strategy == "anil":
+            from .strategies import anil_adapt_loop
+
+            return anil_adapt_loop(
+                self,
+                fast_weights,
+                state.bn_state,
+                hparams,
+                inner_state,
+                x_support,
+                y_support,
+                second_order=False,
+                num_steps=num_steps,
+                support_weight=support_weight,
+            )
+        return self._adapt_loop(
+            fast_weights,
+            state.bn_state,
+            hparams,
+            inner_state,
+            x_support,
+            y_support,
+            second_order=False,
+            num_steps=num_steps,
+            support_weight=support_weight,
+        )
+
     def protonet_adapt(self, state: TrainState, x_support, y_support,
                        support_weight=None):
         """ProtoNet ``adapt`` (core/strategies.py): one embedding forward +

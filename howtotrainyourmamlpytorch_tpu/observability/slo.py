@@ -5,7 +5,8 @@ pieces this module owns:
 
 - :func:`generate_schedule` — a **deterministic** open-loop request
   schedule: heavy-tailed (lognormal) inter-arrivals over an offered-load
-  staircase, mixed adapt/predict traffic, bucket-skewed query sizes. Same
+  staircase, mixed adapt/refine/predict traffic, bucket-skewed query
+  sizes. Same
   seed, same arguments => bit-identical schedule (test-pinned), so two load
   tests across a code change offer *exactly* the same traffic.
 - :func:`run_load` — drive a live ``ServingFrontend`` (in-process; the HTTP
@@ -54,7 +55,7 @@ class Request:
     determines the payload (support/query content) deterministically."""
 
     t: float
-    kind: str  # "adapt" | "predict"
+    kind: str  # "adapt" | "predict" | "refine"
     episode_seed: int
     n_query: int
     stair: int  # index into the offered-load staircase
@@ -72,6 +73,7 @@ def generate_schedule(
     tail_sigma: float = DEFAULT_TAIL_SIGMA,
     tenants: Optional[Sequence[str]] = None,
     tenant_weights: Optional[Sequence[float]] = None,
+    refine_frac: float = 0.0,
 ) -> List[Request]:
     """Deterministic open-loop schedule: ``duration_s`` split evenly across
     ``stairs_rps`` offered-load stages; within a stage, inter-arrivals are
@@ -81,11 +83,20 @@ def generate_schedule(
     buckets, a tail hits the big ones). With ``tenants``, each request
     additionally draws a tenant id, skewed by ``tenant_weights`` (uniform
     when None); without, no extra RNG draws happen, so pre-tenancy seeds
-    keep bit-identical schedules."""
+    keep bit-identical schedules. ``refine_frac`` carves session-refinement
+    traffic (kind ``"refine"``: a new support set against an existing
+    adaptation id) out of the predict share using the SAME uniform draw
+    that picks adapt-vs-predict, so 0.0 keeps pre-refinement seeds
+    bit-identical."""
     if not stairs_rps:
         raise ValueError("stairs_rps must name at least one offered load")
     if duration_s <= 0:
         raise ValueError(f"duration_s must be > 0, got {duration_s}")
+    if refine_frac < 0 or adapt_frac + refine_frac > 1:
+        raise ValueError(
+            f"refine_frac must satisfy 0 <= refine_frac <= 1 - adapt_frac, "
+            f"got refine_frac={refine_frac} adapt_frac={adapt_frac}"
+        )
     weights = np.asarray(query_weights, np.float64)
     weights = weights / weights.sum()
     t_weights = None
@@ -115,10 +126,21 @@ def generate_schedule(
             t += float(rng.lognormal(mu, tail_sigma))
             if t >= end:
                 break
+            # ONE uniform draw splits adapt / refine / predict: at
+            # refine_frac=0 the second band is empty and the draw count and
+            # thresholds are exactly the historical adapt-vs-predict split,
+            # so pre-refinement seeds stay bit-identical
+            u = rng.random()
+            if u < adapt_frac:
+                kind = "adapt"
+            elif u < adapt_frac + refine_frac:
+                kind = "refine"
+            else:
+                kind = "predict"
             schedule.append(
                 Request(
                     t=round(t, 6),
-                    kind="adapt" if rng.random() < adapt_frac else "predict",
+                    kind=kind,
                     episode_seed=int(rng.integers(0, 2**31)),
                     n_query=int(query_sizes[int(rng.choice(len(weights), p=weights))]),
                     stair=stair,
@@ -139,7 +161,11 @@ def schedule_digest(schedule: List[Request]) -> Dict[str, Any]:
     return {
         "n": len(schedule),
         "kinds": {
-            k: sum(1 for r in schedule if r.kind == k) for k in ("adapt", "predict")
+            # the refine key only appears on schedules that carry refines:
+            # refine-off digests stay byte-identical to pre-refinement ones
+            k: sum(1 for r in schedule if r.kind == k)
+            for k in ("adapt", "predict")
+            + (("refine",) if any(r.kind == "refine" for r in schedule) else ())
         },
         "per_stair": [
             sum(1 for r in schedule if r.stair == s)
@@ -270,6 +296,24 @@ class HttpFrontend:
             payload["tenant"] = tenant
         return self._post("/adapt", payload, ctx)
 
+    def refine(
+        self, session_id: str, x_support, y_support, ctx=None, tenant=None
+    ) -> Dict[str, Any]:
+        """Guarded in-place refinement of an existing session: POST /adapt
+        with ``refine: true`` + ``session_id`` (the wire shape the gateway's
+        session affinity keys on). A quarantined session's 409 lands in the
+        generic ``error`` outcome bucket — honest load-test failure, never a
+        silent retry."""
+        payload = {
+            "session_id": session_id,
+            "refine": True,
+            "x_support": np.asarray(x_support, np.float32).tolist(),
+            "y_support": np.asarray(y_support, np.int32).tolist(),
+        }
+        if tenant is not None:
+            payload["tenant"] = tenant
+        return self._post("/adapt", payload, ctx)
+
     def predict(self, adaptation_id: str, x_query, ctx=None, tenant=None) -> np.ndarray:
         payload = {
             "adaptation_id": adaptation_id,
@@ -390,8 +434,13 @@ def run_load(
     results = _Results()
     # adaptation-id pools are PER TENANT (None = default): an adaptation id
     # carries its tenant's checkpoint fingerprint, so a predict naming a
-    # different tenant's id is an honest 404, never load-test traffic
-    ids: Dict[Optional[str], List[str]] = {None: []}
+    # different tenant's id is an honest 404, never load-test traffic.
+    # Entries are (adaptation_id, episode_seed) so refine traffic can
+    # re-send the SESSION'S OWN task data (steady-state refinement): a
+    # refine carrying some other episode's support is a different task,
+    # which the regression guard correctly rolls back — a rollback storm
+    # is the fault drill's job, not the load test's.
+    ids: Dict[Optional[str], List[tuple]] = {None: []}
     ids_lock = threading.Lock()
 
     # -- warmup: compile + seed the adaptation pool (excluded). One predict
@@ -401,9 +450,9 @@ def run_load(
         x_s, y_s = make_support(-(i + 1))
         info = frontend.adapt(x_s, y_s)
         with ids_lock:
-            ids[None].append(info["adaptation_id"])
+            ids[None].append((info["adaptation_id"], -(i + 1)))
     for n_query in sorted({r.n_query for r in schedule}):
-        frontend.predict(ids[None][0], make_query(-1, n_query))
+        frontend.predict(ids[None][0][0], make_query(-1, n_query))
     # one warm adapt per scheduled tenant: seeds each tenant's id pool so
     # every scheduled predict has a same-tenant adaptation to resolve
     # (pages the tenant in, which is exactly one host->device transfer —
@@ -412,7 +461,23 @@ def run_load(
         x_s, y_s = make_support(-1001 - j)
         info = frontend.adapt(x_s, y_s, tenant=tenant)
         with ids_lock:
-            ids.setdefault(tenant, []).append(info["adaptation_id"])
+            ids.setdefault(tenant, []).append((info["adaptation_id"], -1001 - j))
+    # one warm refine per tenant the refine traffic names: settles the
+    # session's probe carve + baseline probe score before the clock starts
+    # (refine-free schedules change NOTHING — no extra warm calls)
+    refine_fn = getattr(frontend, "refine", None)
+    for tenant in sorted(
+        {r.tenant for r in schedule if r.kind == "refine"},
+        key=lambda t: (t is not None, t or ""),
+    ):
+        if refine_fn is None:
+            log("loadgen: refine warmup skipped (frontend has no refine)")
+            break
+        warm_id, warm_seed = ids[tenant][0]
+        x_s, y_s = make_support(warm_seed)
+        refine_fn(
+            warm_id, x_s, y_s, **({"tenant": tenant} if tenant else {})
+        )
     _warm_batch_buckets(frontend, schedule, make_support, make_query, log)
     log(
         "loadgen: warm "
@@ -438,6 +503,7 @@ def run_load(
 
     adapt_takes_ctx = _takes_ctx(frontend.adapt)
     predict_takes_ctx = _takes_ctx(frontend.predict)
+    refine_takes_ctx = refine_fn is not None and _takes_ctx(refine_fn)
 
     def one(req: Request, sched_t: float) -> None:
         ctx = new_request_context()
@@ -452,12 +518,29 @@ def run_load(
                 else:
                     info = frontend.adapt(x_s, y_s, **tenant_kw)
                 with ids_lock:
-                    ids.setdefault(req.tenant, []).append(info["adaptation_id"])
+                    ids.setdefault(req.tenant, []).append(
+                        (info["adaptation_id"], req.episode_seed)
+                    )
+                outcome = "ok"
+            elif req.kind == "refine":
+                # refine an existing session (same id-pool draw as predict)
+                # with ITS OWN task's support — the steady-state
+                # online-refinement workload; a rollback is still an "ok"
+                # response (the guard's honest 200), a quarantine 409 lands
+                # in "error"
+                with ids_lock:
+                    pool_ids = ids[req.tenant]
+                    sid, sseed = pool_ids[req.episode_seed % len(pool_ids)]
+                x_s, y_s = make_support(sseed)
+                if refine_takes_ctx:
+                    refine_fn(sid, x_s, y_s, ctx=ctx, **tenant_kw)
+                else:
+                    frontend.refine(sid, x_s, y_s, **tenant_kw)
                 outcome = "ok"
             else:
                 with ids_lock:
                     pool_ids = ids[req.tenant]
-                    aid = pool_ids[req.episode_seed % len(pool_ids)]
+                    aid = pool_ids[req.episode_seed % len(pool_ids)][0]
                 query = make_query(req.episode_seed, req.n_query)
                 if predict_takes_ctx:
                     frontend.predict(aid, query, ctx=ctx, **tenant_kw)

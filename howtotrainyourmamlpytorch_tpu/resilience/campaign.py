@@ -256,6 +256,14 @@ def episode_menu(rng: np.random.RandomState) -> List[Episode]:
         # the whole mixed stream, unknown strategy = 400 over the wire,
         # and every non-200 resolvable to an access line
         Episode(kind="serve-strategy-mix", mode="serve"),
+        # guarded online refinement under injected poison (ISSUE 17): a
+        # healthy refine commits; a nan-loss refinement rolls back to the
+        # last-good snapshot with an HONEST rolled_back:true 200 and
+        # bit-identical post-rollback predictions; a consecutive-regression
+        # burst quarantines the session (409 + Retry-After on the wire,
+        # predict refused too); explicit re-adapt is the only exit; the
+        # sealed guard sees ZERO outside-prewarm compiles throughout
+        Episode(kind="serve-refine-rollback", mode="serve"),
         # 4 tenants thrashing a weight-pager budget that fits only 2:
         # per-tenant responses stay bit-identical to single-tenant control
         # engines, every eviction is a logged event, the sealed guard sees
@@ -271,6 +279,13 @@ def episode_menu(rng: np.random.RandomState) -> List[Episode]:
         Episode(kind="gateway-kill9-backend", mode="gateway", subprocess=True),
         Episode(kind="gateway-drain-rehydrate", mode="gateway", subprocess=True),
         Episode(kind="gateway-rolling-restart", mode="gateway", subprocess=True),
+        # long-lived refined session across process deaths (ISSUE 17): a
+        # refined session's lineage (refine count, snapshots, probe) must
+        # ride the SIGTERM drain spill -> rehydrate round-trip AND survive a
+        # kill -9 of the gateway in front of it — post-recovery predictions
+        # bit-identical, the next refine continuing the lineage, never a
+        # silently-reset session
+        Episode(kind="serve-refine-across-drain", mode="gateway", subprocess=True),
     ]
     order = rng.permutation(len(menu))
     return [menu[i] for i in order]
@@ -852,6 +867,190 @@ def _run_serve_episode(ep: Episode) -> List[str]:
                 f"access lines do not carry both strategies: "
                 f"{sorted(strategies_logged)}"
             )
+    elif ep.kind == "serve-refine-rollback":
+        # Guarded online refinement under injected poison. Invariants:
+        # (1) a healthy refine commits (refined:true, refine_count 1);
+        # (2) a nan-loss refinement is an HONEST rolled_back:true 200 with
+        # score null, and post-rollback predictions are bit-identical to
+        # the last-good weights' — the poisoned candidate never lands;
+        # (3) a consecutive-regression burst quarantines the session: 409 +
+        # Retry-After + quarantined:true on the wire, and predict through
+        # the quarantined session is refused the same way — never
+        # silently-stale; (4) explicit re-adapt is the only exit (served
+        # as a miss, never from cache); (5) the sealed recompile guard sees
+        # ZERO outside-prewarm compiles across the whole adapt/refine/
+        # predict stream; (6) rollback/quarantine/re-adapt are logged
+        # events and every non-200 resolves to an access-log line.
+        import dataclasses
+        import tempfile
+        import urllib.error
+        import urllib.request
+
+        from ..observability.context import read_access_log
+
+        refine_cfg = dataclasses.replace(
+            cfg,
+            strict_recompile_guard=True,
+            serving=ServingConfig(
+                support_buckets=[16], query_buckets=[16], max_batch_size=2,
+                refine_enabled=True, refine_quarantine_after=2,
+            ),
+        )
+        refine_system = MAMLSystem(
+            refine_cfg,
+            model=build_vgg(img, 5, num_stages=2, cnn_num_filters=4),
+        )
+        engine = AdaptationEngine(refine_system, refine_system.init_train_state())
+        warm = engine.prewarm(max_workers=1)
+        if warm["errors"]:
+            violations.append(f"refine-grid prewarm errors: {warm}")
+        access_dir = tempfile.mkdtemp(prefix="chaos_access_")
+        frontend = ServingFrontend(engine, access_log_dir=access_dir)
+        server = make_http_server(frontend, "127.0.0.1", 0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        non_200_ids = []
+
+        def _post(path, body, timeout=60):
+            req = urllib.request.Request(
+                base + path,
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+
+        def _expect_409(path, body, what):
+            try:
+                _post(path, body)
+                violations.append(f"{what} returned 200 while quarantined")
+                return
+            except urllib.error.HTTPError as exc:
+                if exc.code != 409:
+                    violations.append(f"{what} returned {exc.code}, not 409")
+                if "Retry-After" not in exc.headers:
+                    violations.append(f"quarantine 409 without Retry-After ({what})")
+                payload_err = _loads_or_empty(exc.read())
+                if payload_err.get("quarantined") is not True:
+                    violations.append(
+                        f"quarantine 409 body lacks quarantined:true: {payload_err}"
+                    )
+                rid = exc.headers.get("X-Request-Id")
+                if rid:
+                    non_200_ids.append((exc.code, rid))
+
+        try:
+            epi4 = synthetic_batch(1, 5, 2, 3, img, seed=41)
+            x_s, y_s = epi4["x_support"][0], epi4["y_support"][0]
+            x_q = epi4["x_target"][0].reshape((-1,) + img)
+            payload = {"x_support": x_s.tolist(), "y_support": y_s.tolist()}
+            refine_body = {**payload, "refine": True}
+            _, out = _post("/adapt", payload)
+            sid = out["adaptation_id"]
+            refine_body["session_id"] = sid
+            # (1) healthy refine commits
+            _, r1 = _post("/adapt", refine_body)
+            if (
+                not r1.get("refined")
+                or r1.get("rolled_back")
+                or r1.get("refine_count") != 1
+            ):
+                violations.append(f"healthy refine did not commit: {r1}")
+            _, good = _post(
+                "/predict", {"adaptation_id": sid, "x_query": x_q.tolist()}
+            )
+            # (2) poisoned refinements roll back honestly
+            engine.injector = FaultInjector.from_specs(
+                ["serving.refine=nan-loss:times=3"], include_env=False
+            )
+            _, r2 = _post("/adapt", refine_body)
+            if (
+                not r2.get("rolled_back")
+                or r2.get("score") is not None
+                or r2.get("refine_count") != 1
+            ):
+                violations.append(f"nan-loss refine not rolled back honestly: {r2}")
+            _, after = _post(
+                "/predict", {"adaptation_id": sid, "x_query": x_q.tolist()}
+            )
+            if after.get("probs") != good.get("probs"):
+                violations.append(
+                    "post-rollback predictions differ from last-good — the "
+                    "poisoned candidate landed in the session cache"
+                )
+            # (3) second consecutive regression quarantines: refine AND
+            # predict both refused with an honest 409
+            _expect_409("/adapt", refine_body, "quarantine-burst refine")
+            _expect_409(
+                "/predict",
+                {"adaptation_id": sid, "x_query": x_q.tolist()},
+                "quarantined-session predict",
+            )
+            # (4) explicit re-adapt is the only exit — served as a miss
+            engine.injector = FaultInjector.from_specs([], include_env=False)
+            _, out2 = _post("/adapt", payload)
+            if out2.get("cached"):
+                violations.append(
+                    "re-adapt of a quarantined session was served from cache"
+                )
+            code, _ = _post(
+                "/predict", {"adaptation_id": sid, "x_query": x_q.tolist()}
+            )
+            if code != 200:
+                violations.append(f"post-re-adapt predict failed: {code}")
+            _, r3 = _post("/adapt", refine_body)
+            if r3.get("rolled_back") or r3.get("refine_count") != 1:
+                violations.append(
+                    f"post-re-adapt refine did not start a fresh lineage: {r3}"
+                )
+            # (5) the sealed guard saw zero outside-prewarm compiles
+            snap = engine.recompile_guard.snapshot()
+            if not snap["prewarmed"] or snap["violations"]:
+                violations.append(
+                    f"sealed-guard invariant broken under refine traffic: {snap}"
+                )
+            metrics = frontend.metrics()
+            json.dumps(metrics)  # observability stays well-formed
+            ref = (metrics.get("sessions") or {}).get("refine") or {}
+            if not ref.get("rollbacks") or not ref.get("quarantines"):
+                violations.append(
+                    f"/metrics sessions.refine does not tell the story: {ref}"
+                )
+        finally:
+            server.shutdown()
+            server.server_close()
+            frontend.close()
+            thread.join(timeout=5)
+        # (6) rollback/quarantine/re-adapt are logged events...
+        events_path = os.path.join(access_dir, "events.jsonl")
+        seen_events = set()
+        if os.path.exists(events_path):
+            with open(events_path) as f:
+                for line in f:
+                    try:
+                        seen_events.add(json.loads(line).get("event"))
+                    except ValueError:
+                        continue
+        for required in (
+            "refine_rollback", "session_quarantined", "session_readapted"
+        ):
+            if required not in seen_events:
+                violations.append(f"missing {required} event in events.jsonl")
+        # ...and every non-200 resolves to an access line
+        records, torn = read_access_log(os.path.join(access_dir, "access.jsonl"))
+        if torn:
+            violations.append(f"{torn} torn access.jsonl line(s)")
+        logged_ids = {r.get("trace_id") for r in records}
+        for code, rid in non_200_ids:
+            if rid not in logged_ids:
+                violations.append(
+                    f"non-200 ({code}) request {rid} has no access-log line"
+                )
+        if not non_200_ids:
+            violations.append(
+                "drill produced no non-200 responses — invariant untested"
+            )
     elif ep.kind == "serve-tenant-thrash":
         # M=4 tenants behind ONE strict-mode frontend, paged under a byte
         # budget sized to fit only M/2 of their masters. Invariants:
@@ -1074,6 +1273,7 @@ def make_serving_run_dir(
     name: str,
     template: Optional[str] = None,
     perturb_seed: Optional[int] = None,
+    serving_overrides: Optional[Dict[str, Any]] = None,
 ) -> str:
     """A toy SERVING run dir a backend subprocess can load: config.yaml +
     an init-state checkpoint + logs/. ``template`` copies another run dir's
@@ -1084,7 +1284,10 @@ def make_serving_run_dir(
     saving, so multi-tenant drills get DISTINCT checkpoints (distinct
     fingerprints, distinct predictions) that still share the one tree
     structure the compiled programs key on — the deterministic init would
-    otherwise hand every "tenant" the same fingerprint."""
+    otherwise hand every "tenant" the same fingerprint. ``serving_overrides``
+    patches the run's ServingConfig (e.g. ``refine_enabled``); with a
+    ``template`` the checkpoint is still copied byte-for-byte (same
+    fingerprint), only the config is rewritten."""
     import shutil
 
     run_dir = os.path.join(root, name)
@@ -1092,10 +1295,22 @@ def make_serving_run_dir(
     os.makedirs(save_dir, exist_ok=True)
     os.makedirs(os.path.join(run_dir, "logs"), exist_ok=True)
     if template is not None:
-        shutil.copy(
-            os.path.join(template, "config.yaml"),
-            os.path.join(run_dir, "config.yaml"),
-        )
+        if serving_overrides:
+            from ..config import load_config, save_config
+
+            tcfg = load_config(os.path.join(template, "config.yaml"))
+            tcfg = dataclasses.replace(
+                tcfg,
+                serving=dataclasses.replace(tcfg.serving, **serving_overrides),
+                experiment_root=root,
+                experiment_name=name,
+            )
+            save_config(tcfg, os.path.join(run_dir, "config.yaml"))
+        else:
+            shutil.copy(
+                os.path.join(template, "config.yaml"),
+                os.path.join(run_dir, "config.yaml"),
+            )
         shutil.copy(
             os.path.join(template, "saved_models", "train_model_latest"),
             os.path.join(save_dir, "train_model_latest"),
@@ -1113,7 +1328,7 @@ def make_serving_run_dir(
         number_of_evaluation_steps_per_iter=2,
         serving=ServingConfig(
             support_buckets=[16], query_buckets=[16], max_batch_size=2,
-            cache_ttl_s=600.0,
+            cache_ttl_s=600.0, **(serving_overrides or {}),
         ),
         # AOT on: the respawned replica of a rolling restart loads its
         # executables from the run's store instead of recompiling — the
@@ -1354,6 +1569,8 @@ def _run_gateway_episode(
             violations += _drill_drain_rehydrate(root, template_run, procs)
         elif ep.kind == "gateway-rolling-restart":
             violations += _drill_rolling_restart(root, template_run, procs)
+        elif ep.kind == "serve-refine-across-drain":
+            violations += _drill_refine_across_drain(root, template_run, procs)
         else:
             violations.append(f"unknown gateway episode kind {ep.kind!r}")
     except Exception as exc:  # noqa: BLE001 — a drill crash is the finding
@@ -1743,6 +1960,136 @@ def _drill_rolling_restart(root, template_run, procs) -> List[str]:
                 violations.append(
                     f"non-200 ({c}) request {rid} has no gateway access line"
                 )
+    return violations
+
+
+def _drill_refine_across_drain(root, template_run, procs) -> List[str]:
+    """Long-lived refined session across process deaths: adapt -> refine
+    (lineage committed) -> SIGTERM drain (spill carries the lineage) ->
+    respawn (rehydrate) -> predict bit-identical WITHOUT re-adapt and the
+    next refine CONTINUES the lineage (refine_count 2, never a reset) ->
+    kill -9 the gateway and front the same backend with a fresh one ->
+    session still bit-identical and still refining (lineage lives with the
+    session, not the gateway)."""
+    violations: List[str] = []
+    template = template_run or make_serving_run_dir(root, "template")
+    # same checkpoint bytes as the fleet template (same fingerprint), but
+    # the run's OWN config turns the stateful-session path on
+    run_dir = make_serving_run_dir(
+        root, "b0", template=template,
+        serving_overrides={"refine_enabled": True},
+    )
+    port = _free_port()
+    proc, _ = spawn_serve_backend(run_dir, port=port)
+    procs.append(proc)
+    url = f"http://127.0.0.1:{port}"
+    _wait_http_ok(url + "/healthz", timeout_s=300.0, proc=proc)
+    gw_logs = os.path.join(root, "gateway", "logs")
+    gw_proc, gw_url = spawn_gateway([url], gw_logs)
+    procs.append(gw_proc)
+    _wait_http_ok(gw_url + "/healthz", timeout_s=30.0, proc=gw_proc)
+    support, query = _adapt_payload(53)
+    code, body, _ = _http_json(gw_url + "/adapt", support, timeout_s=60.0)
+    if code != 200:
+        return [f"warm adapt failed: {code} {body}"]
+    sid = body["adaptation_id"]
+    refine_body = {**support, "refine": True, "session_id": sid}
+    code, body, _ = _http_json(gw_url + "/adapt", refine_body, timeout_s=60.0)
+    if code != 200 or not body.get("refined") or body.get("rolled_back"):
+        return [f"warm refine failed: {code} {body}"]
+    if body.get("refine_count") != 1:
+        violations.append(f"first refine count != 1: {body}")
+    code, body, _ = _http_json(
+        gw_url + "/predict", {"adaptation_id": sid, "x_query": query},
+        timeout_s=60.0,
+    )
+    if code != 200:
+        return [f"warm predict failed: {code}"]
+    probs_refined = body["probs"]
+    # SIGTERM: graceful drain must spill the session WITH its lineage
+    proc.send_signal(15)
+    try:
+        rc = proc.wait(timeout=90)
+    except subprocess.TimeoutExpired:
+        return violations + ["drained backend never exited"]
+    if rc != 0:
+        violations.append(f"clean drain exited rc {rc} (want 0)")
+    # respawn the SAME run dir on the SAME port: rehydration must restore
+    # the refined weights AND the lineage
+    proc2, _ = spawn_serve_backend(run_dir, port=port)
+    procs.append(proc2)
+    _wait_http_ok(url + "/healthz", timeout_s=300.0, proc=proc2)
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        code, m, _ = _http_json(gw_url + "/metrics", timeout_s=10.0)
+        if m.get("backends_in") == 1:
+            break
+        time.sleep(0.3)
+    code, body, _ = _http_json(
+        gw_url + "/predict", {"adaptation_id": sid, "x_query": query},
+        timeout_s=90.0,
+    )
+    if code != 200:
+        violations.append(
+            f"post-drain predict for the refined session failed: {code} "
+            "(rehydration lost the session)"
+        )
+    elif body.get("probs") != probs_refined:
+        violations.append(
+            "rehydrated session served predictions differing from its "
+            "refined weights — the refinement was lost in the spill"
+        )
+    code, body, _ = _http_json(gw_url + "/adapt", refine_body, timeout_s=90.0)
+    if code != 200 or body.get("rolled_back"):
+        violations.append(f"post-drain refine failed: {code} {body}")
+    elif body.get("refine_count") != 2:
+        violations.append(
+            f"post-drain refine did not CONTINUE the lineage "
+            f"(refine_count {body.get('refine_count')}, want 2) — the "
+            "spill dropped the session's history"
+        )
+    code, metrics, _ = _http_json(url + "/metrics", timeout_s=30.0)
+    sessions = metrics.get("sessions") or {}
+    if int(sessions.get("rehydrated", 0)) < 1:
+        violations.append(f"backend reports no rehydrated sessions: {sessions}")
+    refine_stats = sessions.get("refine") or {}
+    if int(refine_stats.get("active_lineages", 0)) < 1:
+        violations.append(
+            f"no active lineage after rehydrate: {refine_stats}"
+        )
+    probs_after_refine2 = None
+    code, body, _ = _http_json(
+        gw_url + "/predict", {"adaptation_id": sid, "x_query": query},
+        timeout_s=60.0,
+    )
+    if code == 200:
+        probs_after_refine2 = body["probs"]
+    # kill -9 the GATEWAY: the session and its lineage live with the
+    # backend, so a fresh gateway over the same backend must serve the
+    # session bit-identically and keep refining it
+    os.kill(gw_proc.pid, 9)
+    gw_proc2, gw_url2 = spawn_gateway([url], os.path.join(root, "gateway2", "logs"))
+    procs.append(gw_proc2)
+    _wait_http_ok(gw_url2 + "/healthz", timeout_s=30.0, proc=gw_proc2)
+    code, body, _ = _http_json(
+        gw_url2 + "/predict", {"adaptation_id": sid, "x_query": query},
+        timeout_s=90.0,
+    )
+    if code != 200:
+        violations.append(
+            f"post-gateway-kill predict failed through the new gateway: {code}"
+        )
+    elif probs_after_refine2 is not None and body.get("probs") != probs_after_refine2:
+        violations.append(
+            "predictions changed across the gateway failover — the session "
+            "was silently reset or displaced"
+        )
+    code, body, _ = _http_json(gw_url2 + "/adapt", refine_body, timeout_s=90.0)
+    if code != 200 or body.get("refine_count") != 3:
+        violations.append(
+            f"refine through the new gateway did not continue the lineage: "
+            f"{code} {body}"
+        )
     return violations
 
 

@@ -28,6 +28,9 @@ Sites wired in this codebase (grep for ``fire(`` / ``fire_bytes(``):
 ``serving.http``      ``serving/server.py`` — request handler, after the
                       body is drained (raise = handler bug -> 500, delay =
                       slow client path)
+``serving.refine``    ``serving/engine.py`` — refine dispatch (nan-loss =
+                      poisoned refinement observed by the rollback guard,
+                      raise = dispatch failure, delay = slow refine)
 ==================  ========================================================
 
 Spec grammar (one string per fault; ``;``-separated when packed into the
@@ -78,6 +81,7 @@ SEAMS = (
     "runner.step",
     "serving.dispatch",
     "serving.http",
+    "serving.refine",
 )
 
 # env var merged into every config-built injector: drills on a live run

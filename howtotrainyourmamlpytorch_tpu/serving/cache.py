@@ -55,6 +55,84 @@ def tree_bytes(tree: Any) -> int:
     return sum(leaf.nbytes for leaf in jax.tree.leaves(tree))
 
 
+class SessionLineage:
+    """Versioned session state for guarded update-in-place refinement
+    (ISSUE 17): a cache entry that has been refined is no longer a one-shot
+    memo but a VERSION, and this record carries its history — committed
+    refinement count, held-out score trail, a bounded ring of previously
+    committed fast-weight versions (the rollback targets), the
+    consecutive-regression streak, and the quarantine flag. The frontend
+    (``serving/server.py::ServingFrontend.refine``) owns the guard POLICY;
+    this is the bookkeeping that rides ``SessionStore`` spill/rehydrate so
+    lineage survives drains and rolling restarts. Not thread-safe by
+    itself — the frontend serializes mutations under its lineage lock."""
+
+    #: held-out score history bound: enough for a trend, never unbounded
+    MAX_SCORES = 32
+
+    def __init__(self, snapshot_ring: int = 2):
+        self.snapshot_ring = max(1, int(snapshot_ring))
+        self.refine_count = 0
+        self.rollbacks = 0
+        self.consecutive_regressions = 0
+        self.quarantined = False
+        self.scores: list = []  # committed held-out scores, oldest first
+        self.snapshots: list = []  # previous committed trees, oldest first
+        # persistent held-out probe (x, y): carved from the FIRST refine's
+        # support set, so every later refinement is scored against the same
+        # yardstick — scores stay comparable across the session's life
+        self.probe = None
+
+    @property
+    def last_good_score(self):
+        return self.scores[-1] if self.scores else None
+
+    def set_baseline(self, score: float) -> None:
+        """Seed the score trail with the PRE-refinement weights' held-out
+        score (first refine only): the first guard comparison needs a
+        last-good to regress against."""
+        if not self.scores:
+            self.scores.append(float(score))
+
+    def commit(self, previous_tree: Any, score: float) -> None:
+        """A refinement passed the guard: the previously committed weights
+        join the (bounded) snapshot ring, the score joins the trail, and
+        the regression streak resets."""
+        self.snapshots.append(previous_tree)
+        while len(self.snapshots) > self.snapshot_ring:
+            self.snapshots.pop(0)
+        self.scores.append(float(score))
+        while len(self.scores) > self.MAX_SCORES:
+            self.scores.pop(0)
+        self.refine_count += 1
+        self.consecutive_regressions = 0
+
+    def reject(self) -> int:
+        """A refinement failed the guard (non-finite or regressed past
+        tolerance): the candidate is discarded, the session stays at its
+        last-good version. Returns the new consecutive-regression streak —
+        the frontend quarantines at ``serving.refine_quarantine_after``."""
+        self.rollbacks += 1
+        self.consecutive_regressions += 1
+        return self.consecutive_regressions
+
+    def snapshot_bytes(self) -> int:
+        """Bytes held by the rollback ring — the honest extra footprint a
+        refined session carries beyond its live cache entry."""
+        return sum(tree_bytes(t) for t in self.snapshots)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "refine_count": self.refine_count,
+            "rollbacks": self.rollbacks,
+            "consecutive_regressions": self.consecutive_regressions,
+            "quarantined": self.quarantined,
+            "snapshots": len(self.snapshots),
+            "snapshot_bytes": self.snapshot_bytes(),
+            "last_good_score": self.last_good_score,
+        }
+
+
 class AdaptedWeightCache:
     """Thread-safe LRU of adapted parameter pytrees.
 

@@ -158,6 +158,7 @@ class AdaptationEngine:
         # engine calls (tests, bench) may race the dict — guard it.
         self._adapt_jit: Dict[Tuple[str, int, int], Any] = {}
         self._predict_jit: Dict[Tuple[str, int, int], Any] = {}
+        self._refine_jit: Dict[Tuple[str, int, int], Any] = {}
         self._jit_lock = threading.Lock()
         # compile ledger (observability/compile_ledger.py): when set (ctor
         # param, or attribute assignment before the first request — the
@@ -312,6 +313,69 @@ class AdaptationEngine:
                 self._adapt_jit[key] = fn
         return fn
 
+    def _compiled_refine(self, support_size: int, batch: int,
+                         strategy: Optional[str] = None):
+        """Compiled update-in-place refinement: the adapt rollout started
+        FROM a session's cached fast weights (``core/maml.py::
+        refine_fast_weights``) instead of the masters. Same shape-bucketed,
+        task-batched key grid as adapt, but the program takes the stacked
+        fast-weight trees as an argument (like predict). The grid joins the
+        planned sets (utils/strictmode.py) and the prewarm walk
+        (compile/aot.py) ONLY when ``serving.refine_enabled`` is on, so a
+        refine-off engine's program family — and its sealed strict guard —
+        is byte-identical to the pre-session engine. protonet has no
+        fast-weight rollout to refine: the frontend recomputes prototypes
+        through the EXISTING adapt program, never this one."""
+        strategy = strategy or self.strategies[0]
+        if strategy == "protonet":
+            raise ValueError(
+                "protonet has no refine program — prototypes are recomputed "
+                "through the adapt program on refresh"
+            )
+        key = (strategy, support_size, batch)
+        with self._jit_lock:
+            fn = self._refine_jit.get(key)
+            if fn is None:
+                kind = strategy_kind("refine", strategy)
+                if self.recompile_guard is not None:
+                    self.recompile_guard.note((kind, support_size, batch))
+                system, state, num_steps = self.system, self.state, self.num_steps
+
+                if self.pager is not None:
+                    # tenant mode: master state as argument (see
+                    # _compiled_adapt) — hparams/BN still come from the
+                    # tenant's paged master, the rollout starts at the
+                    # session's fast weights
+                    def refine_batched(st, fw, xs, ys, ws):
+                        return jax.vmap(
+                            lambda f, x, y, w: system.refine_fast_weights(
+                                st, f, x, y, num_steps=num_steps,
+                                support_weight=w, strategy=strategy,
+                            )
+                        )(fw, xs, ys, ws)
+                else:
+                    def refine_batched(fw, xs, ys, ws):
+                        return jax.vmap(
+                            lambda f, x, y, w: system.refine_fast_weights(
+                                state, f, x, y, num_steps=num_steps,
+                                support_weight=w, strategy=strategy,
+                            )
+                        )(fw, xs, ys, ws)
+
+                fn = jax.jit(refine_batched)
+                if self.compile_ledger is not None:
+                    fn = self.compile_ledger.wrap_build(
+                        (
+                            f"{strategy_kind('serve_refine', strategy)}"
+                            f"{self.ledger_tag}",
+                            support_size,
+                            batch,
+                        ),
+                        fn,
+                    )
+                self._refine_jit[key] = fn
+        return fn
+
     def _compiled_predict(self, query_size: int, batch: int,
                           strategy: Optional[str] = None):
         strategy = strategy or self.strategies[0]
@@ -458,6 +522,10 @@ class AdaptationEngine:
                 # the configured adaptation-strategy menu (first = default)
                 "strategies": list(self.strategies),
             }
+            if getattr(self.serving, "refine_enabled", False):
+                # only under refine_enabled: a refine-off engine's
+                # compile-counts surface stays byte-identical
+                out["refine_programs"] = len(self._refine_jit)
             if self.registry is not None:
                 # tenant mode: same program set, state passed as an argument
                 out["tenants"] = list(self.registry.tenants())
@@ -571,6 +639,73 @@ class AdaptationEngine:
         """Single-task convenience wrapper over :meth:`adapt_batch`."""
         return self.adapt_batch(
             [(x_support, y_support)], strategy=strategy, tenant=tenant
+        )[0]
+
+    def refine_batch(self, items: List[Tuple[Any, Any, Any]], ctxs=None,
+                     strategy: Optional[str] = None,
+                     tenant: Optional[str] = None):
+        """Refine a same-bucket group of sessions in one device dispatch:
+        each item's K-step rollout starts from its OWN cached fast weights
+        instead of the masters. ``items`` is a list of ``(fast_weights,
+        x_support, y_support)``; returns one refined-parameter pytree per
+        item. ``ctxs``, ``strategy`` and ``tenant`` as in
+        :meth:`adapt_batch` (the batcher group key carries both, so a flush
+        never mixes strategies or tenants). Fires the ``serving.refine``
+        fault seam: ``nan-loss`` returns deliberately non-finite refined
+        weights — the poisoned-refinement drill the frontend's rollback
+        guard must catch."""
+        strategy = validate_request_strategy(strategy, self.strategies)
+        state_arg = self._tenant_state(tenant)
+        fault = self.injector.fire("serving.refine")
+        flat = [self._flatten_support(x, y) for _, x, y in items]
+        sizes = {x.shape[0] for x, _ in flat}
+        bucket = self.support_bucket(max(sizes))
+        xs, ys, ws = [], [], []
+        for x, y in flat:
+            s = x.shape[0]
+            xs.append(_pad_axis0(x, bucket))
+            ys.append(_pad_axis0(y, bucket))
+            ws.append(
+                np.concatenate([np.ones(s, np.float32), np.zeros(bucket - s, np.float32)])
+            )
+        trees = [fw for fw, _, _ in items]
+        n = len(items)
+        b = _batch_bucket(n, self.serving.max_batch_size)
+        while len(xs) < b:  # pad the task axis by replicating the last task
+            xs.append(xs[-1]); ys.append(ys[-1]); ws.append(ws[-1])
+            trees.append(trees[-1])
+        stacked_fw = jax.tree.map(lambda *leaves: jnp.stack(leaves), *trees)
+        fn = self._compiled_refine(bucket, b, strategy=strategy)
+        span_kw = dict(batch=n, bucket=bucket, strategy=strategy)
+        if tenant is not None:
+            span_kw["tenant"] = tenant
+        t0 = time.monotonic()
+        with self.tracer.span(
+            "serve.refine_dispatch", flows=self._dispatch_flows(ctxs), **span_kw
+        ):
+            if self.pager is not None:
+                stacked = fn(
+                    state_arg, stacked_fw, np.stack(xs), np.stack(ys), np.stack(ws)
+                )
+            else:
+                stacked = fn(stacked_fw, np.stack(xs), np.stack(ys), np.stack(ws))
+        self._stamp_dispatch(ctxs, time.monotonic() - t0)
+        out = [jax.tree.map(lambda a, i=i: a[i], stacked) for i in range(n)]
+        if fault == "nan-loss":
+            # poisoned-refinement drill: hand the guard non-finite weights
+            out = [
+                jax.tree.map(lambda a: jnp.full(a.shape, jnp.nan, a.dtype), t)
+                for t in out
+            ]
+        return out
+
+    def refine(self, fast_weights, x_support, y_support,
+               strategy: Optional[str] = None,
+               tenant: Optional[str] = None):
+        """Single-session convenience wrapper over :meth:`refine_batch`."""
+        return self.refine_batch(
+            [(fast_weights, x_support, y_support)], strategy=strategy,
+            tenant=tenant,
         )[0]
 
     def predict_batch(self, items: List[Tuple[Any, Any]], ctxs=None,

@@ -19,6 +19,20 @@ class UnknownAdaptationError(KeyError):
     cache) instead of being served a stale or wrong result."""
 
 
+class SessionQuarantinedError(RuntimeError):
+    """The session's refinement guard hit ``serving.refine_quarantine_after``
+    consecutive held-out regressions: its cached fast weights are untrusted
+    and the frontend refuses to refine OR predict through them (HTTP 409 +
+    ``Retry-After``) until the client re-adapts from the masters — a plain
+    (non-refine) ``/adapt`` with the same support set resets the session.
+    The honest alternative to silently serving a poisoned session."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+        self.status = 409
+
+
 class ServiceUnavailableError(RuntimeError):
     """The serving path refused the request without dispatching it — queue
     full (load shed), circuit breaker open, router admission control, or no

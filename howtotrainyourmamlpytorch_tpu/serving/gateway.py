@@ -406,12 +406,22 @@ class Gateway:
         (predicts follow the backend that adapted their session). Adapt-ish
         requests key on a content hash of the body, so a repeat upload of
         the same support set stays affine without the gateway re-deriving
-        the server-side support digest."""
+        the server-side support digest. A REFINE request (``/adapt`` with
+        ``refine`` + ``session_id``) is session traffic, not content
+        traffic: it must reach the backend holding the session's cached
+        fast weights, so it keys on the session id exactly like a predict —
+        a body hash would scatter refines of one session across the fleet
+        whenever the new support set differs from the original."""
         if path == "/predict":
             payload = _safe_json(body)
             aid = payload.get("adaptation_id")
             if isinstance(aid, str) and aid:
                 return aid, self._session_backend(aid)
+        if path == "/adapt":
+            payload = _safe_json(body)
+            sid = payload.get("session_id")
+            if payload.get("refine") and isinstance(sid, str) and sid:
+                return sid, self._session_backend(sid)
         return hashlib.blake2b(body, digest_size=16).hexdigest(), None
 
     # -- the proxy -----------------------------------------------------

@@ -122,6 +122,24 @@ class EngineReplica:
             pass_contexts=True,
             continuous=continuous,
         )
+        # the refine batcher exists ONLY when refinement is configured on —
+        # with refine_enabled=False the replica's thread census, stats
+        # schema, and watchdog labels are byte-identical to pre-refinement
+        self.refine_batcher: Optional[MicroBatcher] = None
+        if getattr(serving_cfg, "refine_enabled", False):
+            self.refine_batcher = MicroBatcher(
+                lambda key, payloads, ctxs: self.engine.refine_batch(
+                    payloads, ctxs=ctxs, strategy=_key_strategy(key),
+                    tenant=_key_tenant(key),
+                ),
+                max_batch=serving_cfg.max_batch_size,
+                deadline_ms=serving_cfg.batch_deadline_ms,
+                name=f"refine{suffix}",
+                max_queue_depth=resilience_cfg.max_queue_depth,
+                tracer=tracer,
+                pass_contexts=True,
+                continuous=continuous,
+            )
         self._lock = threading.Lock()
         self._alive = True
         self._death_reason: Optional[str] = None
@@ -150,9 +168,12 @@ class EngineReplica:
         return self.alive and self.breaker.state != "open"
 
     def load(self) -> int:
-        """Requests queued or mid-flush across both batchers — the
+        """Requests queued or mid-flush across the replica's batchers — the
         admission-control signal the router sheds on."""
-        return self.adapt_batcher.pending() + self.predict_batcher.pending()
+        load = self.adapt_batcher.pending() + self.predict_batcher.pending()
+        if self.refine_batcher is not None:
+            load += self.refine_batcher.pending()
+        return load
 
     def _count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -252,6 +273,8 @@ class EngineReplica:
             "load": self.load(),
             "counts": counts,
         }
+        if self.refine_batcher is not None:
+            out["refine_batcher"] = self.refine_batcher.stats()
         if reason is not None:
             out["death_reason"] = reason
         return out
@@ -259,6 +282,8 @@ class EngineReplica:
     def close(self, join_timeout_s: float = None) -> None:
         self.adapt_batcher.close(join_timeout_s)
         self.predict_batcher.close(join_timeout_s)
+        if self.refine_batcher is not None:
+            self.refine_batcher.close(join_timeout_s)
 
 
 class EnginePool:
@@ -343,11 +368,22 @@ class EnginePool:
     def batcher_stats(self, kind: str) -> Dict[str, Any]:
         """Fleet-aggregate batcher stats under the single-batcher schema
         (counts summed, ``mean_batch`` recomputed) — /metrics keeps its
-        historical ``adapt_batcher``/``predict_batcher`` keys."""
-        rows = [
-            (r.adapt_batcher if kind == "adapt" else r.predict_batcher).stats()
-            for r in self.replicas
-        ]
+        historical ``adapt_batcher``/``predict_batcher`` keys. ``refine``
+        aggregates the refine batchers (present only with
+        ``refine_enabled``); replicas without one contribute nothing."""
+        if kind == "refine":
+            rows = [
+                r.refine_batcher.stats()
+                for r in self.replicas
+                if r.refine_batcher is not None
+            ]
+            if not rows:
+                return {}
+        else:
+            rows = [
+                (r.adapt_batcher if kind == "adapt" else r.predict_batcher).stats()
+                for r in self.replicas
+            ]
         out: Dict[str, Any] = {}
         for row in rows:
             for key, value in row.items():

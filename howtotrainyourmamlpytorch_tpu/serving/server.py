@@ -26,6 +26,7 @@ import sys
 import threading
 import time
 import urllib.parse
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
@@ -54,7 +55,11 @@ from .engine import AdaptationEngine
 # historical home of the request-path error taxonomy: re-exported so every
 # ``from .server import ServiceUnavailableError`` keeps resolving to the
 # one class the pool/router layers now raise from below the frontend
-from .errors import ServiceUnavailableError, UnknownAdaptationError  # noqa: F401
+from .errors import (  # noqa: F401
+    ServiceUnavailableError,
+    SessionQuarantinedError,
+    UnknownAdaptationError,
+)
 from .metrics import EventCounters, LatencyStats
 from .pool import EnginePool
 from .router import Router, rendezvous_score
@@ -238,6 +243,15 @@ class ServingFrontend:
         # a second SIGTERM blocks on it instead of racing ahead with an
         # empty verdict and shutting the server down mid-drain
         self._drain_done = threading.Event()
+        # --- refinement lineage (serving/cache.py::SessionLineage) --------
+        # per-session refinement history, keyed by the FULL cache key (so
+        # tenant A's lineage can never guard tenant B's session). Bounded
+        # LRU: a lineage evicted here costs nothing but history — the next
+        # refine re-seeds a baseline. Stays empty with refine_enabled=false,
+        # so the refine-off request path never pays for it.
+        self._lineage_lock = threading.Lock()
+        self._lineages: "OrderedDict[Tuple[str, str, str], Any]" = OrderedDict()
+        self._max_lineages = 4096
         # --- session spill/rehydrate (serving/sessions.py) ----------------
         # run-dir engines spill hot adapted sessions at drain and rehydrate
         # them here at startup, so a rolling restart keeps its sessions warm
@@ -292,7 +306,8 @@ class ServingFrontend:
             batchers = [
                 b
                 for r in self.pool.replicas
-                for b in (r.adapt_batcher, r.predict_batcher)
+                for b in (r.adapt_batcher, r.predict_batcher, r.refine_batcher)
+                if b is not None
             ]
             for batcher in batchers:
                 wd = HeartbeatWatchdog(
@@ -409,6 +424,39 @@ class ServingFrontend:
         )
         return (fp, strategy, digest)
 
+    # -- refinement lineage (serving/cache.py::SessionLineage) ----------
+
+    def _lineage_for(self, key: Tuple[str, str, str], create: bool = False):
+        """The session's lineage record (None when it has none and
+        ``create`` is false). Creation binds the configured snapshot-ring
+        bound; the table is a bounded LRU — an evicted lineage costs only
+        history, never correctness (the next refine re-seeds a baseline)."""
+        with self._lineage_lock:
+            lineage = self._lineages.get(key)
+            if lineage is not None:
+                self._lineages.move_to_end(key)
+            elif create:
+                from .cache import SessionLineage
+
+                lineage = SessionLineage(
+                    snapshot_ring=int(
+                        getattr(self.serving, "refine_snapshot_ring", 2)
+                    )
+                )
+                self._lineages[key] = lineage
+                while len(self._lineages) > self._max_lineages:
+                    self._lineages.popitem(last=False)
+            return lineage
+
+    def _pop_lineage(self, key: Tuple[str, str, str]):
+        with self._lineage_lock:
+            return self._lineages.pop(key, None)
+
+    def _quarantined(self, key: Tuple[str, str, str]) -> bool:
+        with self._lineage_lock:
+            lineage = self._lineages.get(key)
+        return lineage is not None and lineage.quarantined
+
     def _count_strategy(self, strategy: str, verb: str, outcome: str) -> None:
         """Per-strategy outcome tally (the /metrics ``strategies`` block and
         obs_top's live strategy mix read these): one increment per request,
@@ -519,6 +567,10 @@ class ServingFrontend:
     def _failure_of(exc: BaseException) -> Tuple[str, int]:
         """Map a request-path exception to its (outcome, HTTP status) pair
         — the access log's taxonomy, identical in-process and over HTTP."""
+        if isinstance(exc, SessionQuarantinedError):
+            # the refinement guard's honest refusal: 409 + Retry-After,
+            # never a silently-stale answer through a poisoned session
+            return "quarantined", exc.status
         if isinstance(exc, ServiceUnavailableError):
             # 503 for replica-side refusals, 429 for router admission —
             # the error carries its own wire status (serving/errors.py)
@@ -573,11 +625,11 @@ class ServingFrontend:
             )
         true_total = sum(
             reg.counter(f"serving.padding.{v}.true_samples")
-            for v in ("adapt", "predict")
+            for v in ("adapt", "predict", "refine")
         )
         padded_total = sum(
             reg.counter(f"serving.padding.{v}.padded_samples")
-            for v in ("adapt", "predict")
+            for v in ("adapt", "predict", "refine")
         )
         if padded_total:
             reg.set_gauge(
@@ -591,7 +643,12 @@ class ServingFrontend:
         reg = self.hub.registry
         out: Dict[str, Any] = {}
         true_total = padded_total = 0
-        for verb in ("adapt", "predict"):
+        # the refine verb joins the block only once refine traffic exists —
+        # a refine-off deployment's padding schema is byte-identical
+        verbs = ["adapt", "predict"]
+        if reg.counter("serving.padding.refine.padded_samples"):
+            verbs.append("refine")
+        for verb in verbs:
             t = reg.counter(f"serving.padding.{verb}.true_samples")
             p = reg.counter(f"serving.padding.{verb}.padded_samples")
             true_total += t
@@ -607,7 +664,7 @@ class ServingFrontend:
         # per-(verb, bucket) request counts + true-sample totals — what
         # scripts/bucket_tune.py tunes edges from via /metrics
         by_bucket: Dict[str, Dict[str, Dict[str, int]]] = {}
-        for verb in ("adapt", "predict"):
+        for verb in verbs:
             prefix = f"serving.padding.{verb}.bucket."
             rows: Dict[str, Dict[str, int]] = {}
             for name, value in reg.counters(prefix).items():  # prefix-stripped
@@ -820,6 +877,8 @@ class ServingFrontend:
     def _spill_sessions(self) -> int:
         """Spill every live adapted session (all replicas' caches) to the
         run dir, content-addressed + digest-wrapped (serving/sessions.py)."""
+        from .sessions import encode_lineage
+
         count = 0
         ttl_s = float(self.serving.cache_ttl_s)
         # reverse fingerprint -> tenant map: only a LOADED tenant master can
@@ -844,9 +903,16 @@ class ServingFrontend:
                     # not worth a spill file (and the rehydrate template is
                     # the parameter tree, which it doesn't match)
                     continue
+                # a refined session's lineage (score trail, rollback ring,
+                # quarantine flag) rides its spill file, so guard state
+                # survives the restart with the weights it guards
+                lineage = self._lineage_for(key)
                 self.session_store.spill(
                     digest, tree, fingerprint, age_s=age_s, ttl_s=ttl_s,
                     strategy=strategy, tenant=tenant,
+                    lineage=(
+                        encode_lineage(lineage) if lineage is not None else None
+                    ),
                 )
                 count += 1
         if count:
@@ -859,6 +925,9 @@ class ServingFrontend:
         rendezvous-affine to, so the router finds them exactly where it
         will look. Anything unsafe is ignored: the fallback is the honest
         404 + re-adapt, never a stale answer."""
+        from .sessions import decode_lineage
+
+        lineage_raw: Dict[str, Dict[str, Any]] = {}
         entries, stats = self.session_store.load_all(
             fingerprint=self.engine.fingerprint,
             template=self.engine.state.params,
@@ -867,6 +936,7 @@ class ServingFrontend:
                 if self.engine.registry is not None
                 else None
             ),
+            lineage_sink=lineage_raw,
         )
         for digest, tree, lived_s, strategy, tenant in entries:
             replica = max(
@@ -875,9 +945,17 @@ class ServingFrontend:
             )
             # back-date by the TTL budget already consumed: a restart must
             # never extend a session's original expiry
-            replica.cache.put(
-                self._cache_key(digest, strategy, tenant), tree, age_s=lived_s
-            )
+            key = self._cache_key(digest, strategy, tenant)
+            replica.cache.put(key, tree, age_s=lived_s)
+            raw = lineage_raw.get(digest)
+            if raw is not None:
+                # restore the refinement guard's memory with the weights it
+                # guards; an undecodable lineage rehydrates as lineage-free
+                # (decode_lineage returns None), never with made-up history
+                lineage = decode_lineage(raw, self.engine.state.params)
+                if lineage is not None:
+                    with self._lineage_lock:
+                        self._lineages[key] = lineage
         self._session_stats = dict(stats, rehydrated=stats["loaded"])
         if any(stats.values()):
             self._event("sessions_rehydrated", **stats)
@@ -938,6 +1016,19 @@ class ServingFrontend:
                 # independently)
                 replica = self.router.route(digest, ctx=ctx)
                 cached = replica.cache.get(key, ctx=ctx) is not None
+                if cached and self._quarantined(key):
+                    # the one exit from quarantine: an explicit re-adapt
+                    # from the masters. The hit is treated as a miss — the
+                    # poisoned entry is recomputed below and its lineage
+                    # (streak, quarantine flag, rollback ring) is discarded
+                    cached = False
+                    if ctx is not None:
+                        ctx.cache_hit = False
+                    self._pop_lineage(key)
+                    self.counters.inc("session_readapts")
+                    self._event(
+                        "session_readapted", session=digest, strategy=strategy
+                    )
                 if not cached:
                     # shed at the router BEFORE the request queues at the
                     # replica (a cache hit above costs nothing — only real
@@ -963,6 +1054,10 @@ class ServingFrontend:
                     )
                     self._note_padding("adapt", x.shape[0], bucket, strategy)
                     replica.cache.put(key, fast_weights)
+                    # a fresh adapt is version zero: any lineage left from a
+                    # previous (expired or re-adapted) life of this key
+                    # must not guard the new weights
+                    self._pop_lineage(key)
                     if tenant is not None:
                         self._sweep_pagers()
         except BaseException as exc:
@@ -990,6 +1085,264 @@ class ServingFrontend:
             "cached": cached,
             "strategy": strategy,
             "support_size": int(x.shape[0]),
+            "latency_ms": round(elapsed * 1e3, 3),
+        }
+        if tenant is not None:
+            out["tenant"] = tenant
+        if ctx is not None:
+            out["trace_id"] = ctx.trace_id
+            out["timing"] = ctx.timing_ms(elapsed)
+        return out
+
+    def _probe_score(
+        self, replica, fast_weights, x_probe, y_probe, strategy, tenant, ctx
+    ) -> float:
+        """Held-out cross-entropy of ``fast_weights`` on the session's
+        probe — the refinement guard's yardstick. Scored through the
+        ordinary predict batcher (a PLANNED program: the guard costs zero
+        extra compiles and the sealed strict-mode invariant holds).
+        Non-finite weights score non-finite honestly: numpy's max
+        propagates NaN, so a poisoned tree can never look like a pass."""
+        bucket = self.engine.query_bucket(x_probe.shape[0])
+        group = (
+            (tenant, strategy, bucket)
+            if tenant is not None
+            else (strategy, bucket)
+        )
+        probs = replica.dispatch(
+            replica.predict_batcher, group, (fast_weights, x_probe), ctx
+        )
+        p = np.asarray(probs, np.float64)
+        idx = np.asarray(y_probe, np.int64)
+        picked = p[np.arange(idx.shape[0]), idx]
+        return float(np.mean(-np.log(np.maximum(picked, 1e-12))))
+
+    def refine(
+        self,
+        session_id: str,
+        x_support,
+        y_support,
+        ctx: Optional[RequestContext] = None,
+        strategy: Optional[str] = None,
+        tenant: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Guarded online refinement of a persistent session (ISSUE 17):
+        continue the K-step rollout from the session's CACHED fast weights
+        (``engine.refine_batch``) instead of re-adapting from the masters,
+        then score the candidate on the session's held-out probe before
+        committing. A non-finite or regressed (past
+        ``serving.refine_regress_tol``) candidate is discarded — the cache
+        keeps the last-good version, the response says ``rolled_back:
+        true`` — and ``serving.refine_quarantine_after`` consecutive
+        regressions quarantine the session (409 + Retry-After; the only
+        exit is an explicit re-adapt). ProtoNet sessions have no
+        fast-weight rollout: their refresh recomputes prototypes through
+        the (planned) adapt program against the new support set, under the
+        same guard."""
+        if not getattr(self.serving, "refine_enabled", False):
+            # -> the HTTP 400 branch: refinement must be configured on
+            raise ValueError(
+                "refinement is disabled (serving.refine_enabled=false)"
+            )
+        strategy = validate_request_strategy(strategy, self.engine.strategies)
+        tenant = validate_request_tenant(tenant, self.engine.registry)
+        ctx = self._request_ctx(ctx)
+        if ctx is not None:
+            ctx.strategy = strategy
+            ctx.tenant = tenant
+        t0 = time.monotonic()
+        entered = False
+        quota_label = None
+        rolled_back = False
+        score: Optional[float] = None
+        try:
+            self._enter_request()
+            entered = True
+            quota_label = self._acquire_quota(tenant)
+            with self.hub.span(
+                "serve.refine", flows=flow_start(ctx),
+                trace=ctx.trace_id if ctx else None,
+            ):
+                key = self._cache_key(session_id, strategy, tenant)
+                # same affinity key as the adapt that cached the session:
+                # the refine lands on the replica holding its fast weights
+                replica = self.router.route(session_id, ctx=ctx)
+                fast_weights = replica.cache.get(key, ctx=ctx)
+                if fast_weights is None:
+                    raise UnknownAdaptationError(
+                        f"unknown or expired session {session_id!r} for "
+                        f"strategy {strategy!r}; re-send the support set "
+                        "via /adapt"
+                    )
+                lineage = self._lineage_for(key, create=True)
+                if lineage.quarantined:
+                    raise SessionQuarantinedError(
+                        f"session {session_id!r} is quarantined after "
+                        f"{lineage.consecutive_regressions} consecutive "
+                        "regressed refinements; re-adapt from the masters "
+                        "via /adapt",
+                        retry_after_s=self.resilience.shed_retry_after_s,
+                    )
+                self.router.admit(replica)
+                x, y = self.engine._flatten_support(x_support, y_support)
+                with self._lineage_lock:
+                    if lineage.probe is None:
+                        # first refine: carve a persistent held-out probe
+                        # from THIS support set — every later refinement
+                        # scores against the same yardstick. Evenly spaced
+                        # indices, because support sets arrive class-major:
+                        # holding out a contiguous tail would hold out a
+                        # whole class, and the train slice losing a class
+                        # makes every first refinement look like a
+                        # regression
+                        n = int(x.shape[0])
+                        if n < 2:
+                            raise ValueError(
+                                "refinement needs >= 2 support samples "
+                                "(one must be held out for the guard)"
+                            )
+                        n_hold = min(
+                            max(1, int(round(n * float(getattr(
+                                self.serving, "refine_holdout_frac", 0.25
+                            ))))),
+                            n - 1,
+                        )
+                        stride = max(1, n // n_hold)
+                        hold = np.zeros(n, bool)
+                        hold[np.arange(n)[stride - 1::stride][:n_hold]] = True
+                        lineage.probe = (
+                            np.asarray(x[hold]), np.asarray(y[hold])
+                        )
+                        x_train, y_train = x[~hold], y[~hold]
+                    else:
+                        # later refines train on the full new support set;
+                        # the probe stays the session's fixed yardstick
+                        x_train, y_train = x, y
+                    probe_x, probe_y = lineage.probe
+                if lineage.last_good_score is None:
+                    # baseline: what the CURRENT weights score — the first
+                    # guard comparison needs a last-good to regress against
+                    base = self._probe_score(
+                        replica, fast_weights, probe_x, probe_y, strategy,
+                        tenant, ctx,
+                    )
+                    if np.isfinite(base):
+                        with self._lineage_lock:
+                            lineage.set_baseline(base)
+                bucket = self.engine.support_bucket(x_train.shape[0])
+                if ctx is not None:
+                    ctx.bucket = bucket
+                    ctx.true_size = int(x_train.shape[0])
+                group = (
+                    (tenant, strategy, bucket)
+                    if tenant is not None
+                    else (strategy, bucket)
+                )
+                if strategy == "protonet":
+                    # no fast-weight rollout to continue: the refresh
+                    # recomputes prototypes from the new support set
+                    # through the planned adapt program
+                    refined = replica.dispatch(
+                        replica.adapt_batcher, group, (x_train, y_train), ctx
+                    )
+                else:
+                    refined = replica.dispatch(
+                        replica.refine_batcher, group,
+                        (fast_weights, x_train, y_train), ctx,
+                    )
+                self._note_padding(
+                    "refine", x_train.shape[0], bucket, strategy
+                )
+                score = self._probe_score(
+                    replica, refined, probe_x, probe_y, strategy, tenant, ctx
+                )
+                tol = float(getattr(self.serving, "refine_regress_tol", 0.5))
+                last_good = lineage.last_good_score
+                regressed = (not np.isfinite(score)) or (
+                    last_good is not None and score > last_good + tol
+                )
+                if regressed:
+                    with self._lineage_lock:
+                        streak = lineage.reject()
+                    rolled_back = True
+                    self.counters.inc("refine_rollbacks")
+                    self._event(
+                        "refine_rollback",
+                        session=session_id,
+                        strategy=strategy,
+                        score=(float(score) if np.isfinite(score) else None),
+                        last_good=last_good,
+                        streak=streak,
+                        **({"tenant": tenant} if tenant else {}),
+                    )
+                    if streak >= int(getattr(
+                        self.serving, "refine_quarantine_after", 3
+                    )):
+                        with self._lineage_lock:
+                            lineage.quarantined = True
+                        self.counters.inc("session_quarantines")
+                        self._event(
+                            "session_quarantined",
+                            session=session_id,
+                            strategy=strategy,
+                            streak=streak,
+                        )
+                        raise SessionQuarantinedError(
+                            f"session {session_id!r} quarantined after "
+                            f"{streak} consecutive regressed refinements; "
+                            "re-adapt from the masters via /adapt",
+                            retry_after_s=self.resilience.shed_retry_after_s,
+                        )
+                else:
+                    with self._lineage_lock:
+                        lineage.commit(fast_weights, score)
+                    replica.cache.put(key, refined)
+                    self.counters.inc("refines")
+                    self._event(
+                        "refine_commit",
+                        session=session_id,
+                        strategy=strategy,
+                        score=float(score),
+                        refine_count=lineage.refine_count,
+                        **({"tenant": tenant} if tenant else {}),
+                    )
+                if tenant is not None:
+                    self._sweep_pagers()
+        except BaseException as exc:
+            outcome, status = self._failure_of(exc)
+            self._count_strategy(strategy, "refine", outcome)
+            self._count_tenant(tenant, "refine", outcome)
+            self._record_access(
+                ctx, "refine", outcome, status, time.monotonic() - t0
+            )
+            raise
+        finally:
+            if quota_label is not None:
+                self.quotas.release(quota_label)
+            if entered:
+                self._exit_request()
+        elapsed = time.monotonic() - t0
+        self.latency.record("refine", elapsed)
+        if strategy != self.engine.strategies[0]:
+            self.latency.record(f"refine@{strategy}", elapsed)
+        self._count_strategy(strategy, "refine", "ok")
+        self._count_tenant(tenant, "refine", "ok")
+        self._record_access(ctx, "refine", "ok", 200, elapsed)
+        out = {
+            "adaptation_id": session_id,
+            "refined": True,
+            # honest verdict: a rolled-back refinement is still a 200 (the
+            # session is SERVABLE, at its last-good version) but says so
+            "rolled_back": rolled_back,
+            "refine_count": lineage.refine_count,
+            "consecutive_regressions": lineage.consecutive_regressions,
+            "score": (
+                float(score)
+                if score is not None and np.isfinite(score)
+                else None
+            ),
+            "strategy": strategy,
+            "support_size": int(x_train.shape[0]),
             "latency_ms": round(elapsed * 1e3, 3),
         }
         if tenant is not None:
@@ -1036,14 +1389,23 @@ class ServingFrontend:
                 # through a gradient strategy's predict program, and tenant
                 # B can never resolve tenant A's weights.
                 replica = self.router.route(adaptation_id, ctx=ctx)
-                fast_weights = replica.cache.get(
-                    self._cache_key(adaptation_id, strategy, tenant), ctx=ctx
-                )
+                key = self._cache_key(adaptation_id, strategy, tenant)
+                fast_weights = replica.cache.get(key, ctx=ctx)
                 if fast_weights is None:
                     raise UnknownAdaptationError(
                         f"unknown or expired adaptation_id {adaptation_id!r} "
                         f"for strategy {strategy!r}; re-send the support set "
                         "via /adapt"
+                    )
+                if self._quarantined(key):
+                    # a quarantined session's weights are untrusted —
+                    # refusing to predict through them is the honest
+                    # alternative to serving a silently-poisoned answer
+                    raise SessionQuarantinedError(
+                        f"session {adaptation_id!r} is quarantined after "
+                        "consecutive regressed refinements; re-adapt from "
+                        "the masters via /adapt",
+                        retry_after_s=self.resilience.shed_retry_after_s,
                     )
                 self.router.admit(replica)
                 x = np.asarray(x_query, np.float32)
@@ -1212,6 +1574,24 @@ class ServingFrontend:
                 **self._session_stats,
                 "pending_on_disk": self.session_store.pending(),
             }
+        if getattr(self.serving, "refine_enabled", False):
+            # the refinement guard's scoreboard (only with the feature on:
+            # a refine-off /metrics payload is byte-identical). Lives under
+            # "sessions" — refinement is session state — created here even
+            # without a session store (in-memory-only deployments refine too)
+            events = self.counters.snapshot()
+            with self._lineage_lock:
+                lineages = list(self._lineages.values())
+            out.setdefault("sessions", {})["refine"] = {
+                "refines": events.get("refines", 0),
+                "rollbacks": events.get("refine_rollbacks", 0),
+                "quarantines": events.get("session_quarantines", 0),
+                "readapts": events.get("session_readapts", 0),
+                "active_lineages": len(lineages),
+                "quarantined": sum(1 for l in lineages if l.quarantined),
+                "snapshot_bytes": sum(l.snapshot_bytes() for l in lineages),
+            }
+            out["refine_batcher"] = self.pool.batcher_stats("refine")
         if self.access_log is not None:
             out["access_log"] = self.access_log.stats()
         if self._memory is not None:
@@ -1388,10 +1768,22 @@ class _Handler(BaseHTTPRequestHandler):
                 strategy = req.get("strategy")
                 tenant = req.get("tenant")
                 if self.path == "/adapt":
-                    out = frontend.adapt(
-                        req["x_support"], req["y_support"], ctx=ctx,
-                        strategy=strategy, tenant=tenant,
-                    )
+                    if req.get("refine"):
+                        # refinement rides /adapt (same wire verb, same
+                        # gateway affinity path): a truthy "refine" +
+                        # "session_id" continues the named session's
+                        # rollout in place. A request WITHOUT the field
+                        # takes the branch below byte-identically.
+                        out = frontend.refine(
+                            req["session_id"], req["x_support"],
+                            req["y_support"], ctx=ctx,
+                            strategy=strategy, tenant=tenant,
+                        )
+                    else:
+                        out = frontend.adapt(
+                            req["x_support"], req["y_support"], ctx=ctx,
+                            strategy=strategy, tenant=tenant,
+                        )
                     self._send_json(200, out)
                 elif self.path == "/predict":
                     probs = frontend.predict(
@@ -1413,6 +1805,19 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._log_http(frontend, "not_found", 404)
                     self._send_json(404, {"error": f"unknown path {self.path}"})
+        except SessionQuarantinedError as exc:
+            # refinement-guard quarantine: honest 409 + Retry-After — the
+            # client must re-adapt from the masters, never read through a
+            # poisoned session
+            self._send_json(
+                exc.status,
+                {
+                    "error": str(exc),
+                    "retry_after_s": exc.retry_after_s,
+                    "quarantined": True,
+                },
+                headers={"Retry-After": str(max(1, int(round(exc.retry_after_s))))},
+            )
         except ServiceUnavailableError as exc:
             # load shed / breaker open (503) or router admission (429):
             # tell the client when to come back

@@ -44,6 +44,61 @@ _PREFIX = "session_"
 _SUFFIX = ".msgpack"
 
 
+def encode_lineage(lineage) -> Dict[str, Any]:
+    """``SessionLineage`` (serving/cache.py) -> msgpack-friendly payload:
+    counters/flags verbatim, the snapshot ring as per-tree ``to_bytes``
+    blobs, the held-out probe as raw arrays. Rides the spill file under the
+    OPTIONAL ``lineage`` key — SESSION_FORMAT stays 1, and pre-refinement
+    readers/files interoperate (an absent key reads as no lineage)."""
+    out: Dict[str, Any] = {
+        "refine_count": int(lineage.refine_count),
+        "rollbacks": int(lineage.rollbacks),
+        "consecutive_regressions": int(lineage.consecutive_regressions),
+        "quarantined": bool(lineage.quarantined),
+        "snapshot_ring": int(lineage.snapshot_ring),
+        "scores": [float(s) for s in lineage.scores],
+        "snapshots": [
+            serialization.to_bytes(jax.tree.map(np.asarray, t))
+            for t in lineage.snapshots
+        ],
+    }
+    if lineage.probe is not None:
+        out["probe_x"] = np.asarray(lineage.probe[0])
+        out["probe_y"] = np.asarray(lineage.probe[1])
+    return out
+
+
+def decode_lineage(payload: Dict[str, Any], template: Any):
+    """Inverse of :func:`encode_lineage`; snapshot trees restore against
+    ``template`` (the same parameter tree the session itself restored
+    against). Returns None on ANY defect — a session whose lineage cannot
+    be trusted rehydrates as a fresh, lineage-free session rather than
+    with made-up history."""
+    from .cache import SessionLineage
+
+    try:
+        lineage = SessionLineage(snapshot_ring=int(payload.get("snapshot_ring", 1)))
+        lineage.refine_count = int(payload.get("refine_count", 0))
+        lineage.rollbacks = int(payload.get("rollbacks", 0))
+        lineage.consecutive_regressions = int(
+            payload.get("consecutive_regressions", 0)
+        )
+        lineage.quarantined = bool(payload.get("quarantined", False))
+        lineage.scores = [float(s) for s in payload.get("scores", [])]
+        lineage.snapshots = [
+            serialization.from_bytes(template, blob)
+            for blob in payload.get("snapshots", [])
+        ]
+        if "probe_x" in payload and "probe_y" in payload:
+            lineage.probe = (
+                np.asarray(payload["probe_x"]),
+                np.asarray(payload["probe_y"]),
+            )
+        return lineage
+    except Exception:  # noqa: BLE001 — untrusted lineage is no lineage
+        return None
+
+
 class SessionStore:
     """Content-addressed spill directory for adapted-weight cache entries."""
 
@@ -65,6 +120,7 @@ class SessionStore:
         wall_clock: Callable[[], float] = time.time,
         strategy: str = "maml++",
         tenant: Optional[str] = None,
+        lineage: Optional[Dict[str, Any]] = None,
     ) -> str:
         """Write one session (its adapted-parameter pytree) atomically,
         digest-wrapped. ``age_s`` is how long the entry had already lived in
@@ -90,6 +146,11 @@ class SessionStore:
             # only non-default tenants stamp the field: a default-tenant
             # spill stays byte-compatible with pre-tenancy readers
             payload["tenant"] = str(tenant)
+        if lineage:
+            # refinement lineage (encode_lineage): optional key, so a
+            # never-refined session's spill file is byte-identical to the
+            # pre-refinement format and old files keep loading
+            payload["lineage"] = lineage
         body = serialization.msgpack_serialize(payload)
         blob = serialization.msgpack_serialize(
             {
@@ -110,6 +171,7 @@ class SessionStore:
         template: Any,
         wall_clock: Callable[[], float] = time.time,
         tenant_fingerprints: Optional[Dict[str, str]] = None,
+        lineage_sink: Optional[Dict[str, Dict[str, Any]]] = None,
     ) -> Tuple[List[Tuple[str, Any, float, str, Optional[str]]], Dict[str, int]]:
         """-> (``[(digest, tree, lived_s, strategy, tenant)]`` safe to
         serve, stats). Digest-verified; corrupt => quarantined ``*.corrupt``;
@@ -126,8 +188,12 @@ class SessionStore:
         serves (serving/registry.py): a spilled tenant session rehydrates
         only when BOTH its recorded tenant is registered AND its
         fingerprint matches that tenant's checkpoint — anything else stays
-        ``foreign``, never a cross-tenant serve. Loaded files are consumed
-        (removed) — they are live cache entries again."""
+        ``foreign``, never a cross-tenant serve. ``lineage_sink`` (optional
+        dict) collects each loaded entry's raw refinement-lineage payload
+        under its digest — callers that track lineage (ServingFrontend)
+        decode it via :func:`decode_lineage`; the 5-tuple return shape is
+        unchanged for everyone else. Loaded files are consumed (removed) —
+        they are live cache entries again."""
         stats = {"loaded": 0, "stale": 0, "corrupt": 0, "foreign": 0}
         entries: List[Tuple[str, Any, float, str, Optional[str]]] = []
         if not os.path.isdir(self.root):
@@ -170,6 +236,10 @@ class SessionStore:
                  str(payload.get("strategy", "maml++")),
                  str(tenant) if tenant is not None else None)
             )
+            if lineage_sink is not None and isinstance(
+                payload.get("lineage"), dict
+            ):
+                lineage_sink[payload["digest"]] = payload["lineage"]
             stats["loaded"] += 1
             os.remove(path)
         return entries, stats

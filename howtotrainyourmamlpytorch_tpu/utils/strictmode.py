@@ -303,12 +303,23 @@ def serving_planned_programs(serving_cfg) -> Set[Tuple[str, int, int]]:
 
     batches = batch_buckets(serving_cfg.max_batch_size)
     strategies = tuple(getattr(serving_cfg, "strategies", None) or ("maml++",))
+    # persistent-session refinement (serving/engine.py::_compiled_refine):
+    # the refine grid mirrors the adapt grid (same support buckets) for
+    # every strategy with a fast-weight rollout — protonet refreshes run
+    # through the EXISTING adapt program, so it plans nothing new. Gated on
+    # serving.refine_enabled so a refine-off deployment's planned set (and
+    # sealed guard, prewarm grid, executable-store manifest) stays
+    # byte-identical to the pre-session engine.
+    refine = bool(getattr(serving_cfg, "refine_enabled", False))
     planned: Set[Tuple[str, int, int]] = set()
     for strategy in strategies:
         adapt_kind = strategy_kind("adapt", strategy)
         predict_kind = strategy_kind("predict", strategy)
         for bucket in serving_cfg.support_buckets:
             planned.update((adapt_kind, bucket, b) for b in batches)
+            if refine and strategy != "protonet":
+                refine_kind = strategy_kind("refine", strategy)
+                planned.update((refine_kind, bucket, b) for b in batches)
         for bucket in serving_cfg.query_buckets:
             planned.update((predict_kind, bucket, b) for b in batches)
     return planned

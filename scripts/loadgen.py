@@ -3,8 +3,10 @@
 
 Generates a seeded heavy-tailed request schedule over an offered-load
 staircase (``observability/slo.py``), drives an in-process
-``ServingFrontend`` with it — mixed adapt/predict, bucket-skewed query
-sizes, launched at schedule time whether or not earlier requests returned —
+``ServingFrontend`` with it — mixed adapt/refine/predict (``--refine-frac``
+carves guarded session refinements out of the predict share), bucket-skewed
+query sizes, launched at schedule time whether or not earlier requests
+returned —
 and prints exactly ONE JSON SLO-report line on stdout (the ``bench.py`` /
 ``bench_serving.py`` contract): per-stair p50/p99 vs offered load, shed
 rate, 503/504 counts, breaker trips, headline = highest offered load whose
@@ -73,6 +75,14 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--adapt-frac", type=float, default=0.25,
                         help="fraction of requests that are (uncached) adapts")
+    parser.add_argument(
+        "--refine-frac", type=float, default=0.0,
+        help="fraction of requests that refine an existing session in place "
+        "(POST /adapt with refine:true; carved out of the predict share by "
+        "the SAME seeded draw, so 0.0 keeps the schedule bit-identical). "
+        "Needs serving.refine_enabled on the target; synthetic-engine runs "
+        "enable it automatically.",
+    )
     parser.add_argument("--slo-p99-ms", type=float, default=2000.0)
     parser.add_argument("--max-shed-rate", type=float, default=0.05)
     parser.add_argument("--run-dir", default=None,
@@ -127,6 +137,12 @@ def main(argv=None) -> int:
     stairs = _parse_stairs(args.stairs)
     if args.tenants < 0:
         raise SystemExit(f"loadgen: --tenants must be >= 0, got {args.tenants}")
+    if args.refine_frac < 0 or args.adapt_frac + args.refine_frac > 1:
+        raise SystemExit(
+            "loadgen: --refine-frac must satisfy 0 <= refine-frac <= "
+            f"1 - adapt-frac, got {args.refine_frac} "
+            f"(adapt-frac {args.adapt_frac})"
+        )
     tenants = [f"t{i}" for i in range(args.tenants)] or None
     tenant_weights = _parse_tenant_skew(args.tenant_skew, args.tenants)
     if args.url and args.run_dir:
@@ -148,6 +164,7 @@ def main(argv=None) -> int:
         query_weights=query_weights,
         tenants=tenants,
         tenant_weights=tenant_weights,
+        refine_frac=args.refine_frac,
     )
     if not schedule:
         # fail fast BEFORE the backend spins up: heavy-tailed gaps over a
@@ -207,6 +224,15 @@ def main(argv=None) -> int:
         # from_run_dir already points access.jsonl at the run's own logs/
         frontend = frontend_from_run_dir(args.run_dir, replicas=args.replicas)
         cfg = frontend.engine.cfg
+        if args.refine_frac and not getattr(
+            frontend.engine.serving, "refine_enabled", False
+        ):
+            # a run dir serves ITS OWN serving config; refuse before the
+            # staircase instead of logging a wall of per-request 400s
+            raise SystemExit(
+                "loadgen: --refine-frac needs serving.refine_enabled in "
+                f"the run dir's config ({args.run_dir})"
+            )
         n_way = cfg.num_classes_per_set
         k_shot = cfg.num_samples_per_class
         model_label = f"run:{os.path.basename(os.path.normpath(args.run_dir))}"
@@ -220,6 +246,9 @@ def main(argv=None) -> int:
             serving=ServingConfig(
                 support_buckets=[n_way * k_shot],
                 query_buckets=sorted(query_sizes),
+                # refine traffic needs the stateful-session path; off keeps
+                # the synthetic engine byte-identical to the legacy config
+                refine_enabled=bool(args.refine_frac),
             ),
         )
         stages, filters = (4, 64) if args.full else (2, 4)
@@ -328,6 +357,25 @@ def main(argv=None) -> int:
                 ),
             }
             if args.tenants
+            else {}
+        ),
+        # refinement runs carry the guard's story (refines / rollbacks /
+        # quarantines off /metrics) next to the latency one; external
+        # targets own their /metrics, so only the knob itself is echoed
+        **(
+            {
+                "refine_frac": args.refine_frac,
+                **(
+                    {
+                        "refine": frontend.metrics()
+                        .get("sessions", {})
+                        .get("refine")
+                    }
+                    if hasattr(frontend, "metrics")
+                    else {}
+                ),
+            }
+            if args.refine_frac
             else {}
         ),
     )

@@ -347,7 +347,8 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
                      "degraded_mesh", "early_abort", "donation_refused",
                      "replica_death", "backend_out", "backend_in",
                      "drain_begin", "drain_complete",
-                     "sessions_spilled", "sessions_rehydrated")
+                     "sessions_spilled", "sessions_rehydrated",
+                     "refine_rollback", "session_quarantined")
         }
         if notable:
             report["notable_events"] = notable
@@ -372,6 +373,10 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
         ]
         if serving_events:
             report["serving_events"] = serving_events
+        # per-session refinement lifecycle (ISSUE 17)
+        refinement = _refinement_from_events(event_records)
+        if refinement is not None:
+            report["refinement"] = refinement
         # donation bookkeeping (ISSUE 12): the audit table (donatable vs
         # donated bytes per planned program) and, when the aliasing
         # self-check refused donation, its verdict
@@ -486,6 +491,66 @@ def _strategies_from_access(
         per[strategy]["p50_ms"] = round(vals[len(vals) // 2], 3)
         per[strategy]["p95_ms"] = round(vals[min(len(vals) - 1, int(len(vals) * 0.95))], 3)
     return dict(sorted(per.items()))
+
+
+def _refinement_from_events(
+    events: List[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """Per-session refinement table (ISSUE 17) replayed off events.jsonl:
+    commits, rollbacks, quarantines and re-adapts per session, plus the
+    committed-score trend (first -> last -> best) so "is this long-lived
+    session actually getting better, or riding its rollback guard" is
+    answerable from the run dir. Sessions are keyed by their short id;
+    returns None for runs with no refinement traffic at all."""
+    per: Dict[str, Dict[str, Any]] = {}
+
+    def _row(session: str, rec: Dict[str, Any]) -> Dict[str, Any]:
+        row = per.setdefault(
+            session,
+            {"refines": 0, "rollbacks": 0, "quarantines": 0, "readapts": 0,
+             "scores": [], "strategy": rec.get("strategy")},
+        )
+        if isinstance(rec.get("tenant"), str):
+            row["tenant"] = rec["tenant"]
+        return row
+
+    saw_refinement = False
+    for rec in events:
+        session = rec.get("session")
+        if not isinstance(session, str):
+            continue
+        event = rec.get("event")
+        if event == "refine_commit":
+            saw_refinement = True
+            row = _row(session, rec)
+            row["refines"] += 1
+            if isinstance(rec.get("score"), (int, float)):
+                row["scores"].append(float(rec["score"]))
+        elif event == "refine_rollback":
+            saw_refinement = True
+            row = _row(session, rec)
+            row["rollbacks"] += 1
+            row["last_streak"] = rec.get("streak")
+        elif event == "session_quarantined":
+            saw_refinement = True
+            _row(session, rec)["quarantines"] += 1
+        elif event == "session_readapted":
+            # only interesting for sessions that refined: a plain cache
+            # miss on a refine-free session is not refinement traffic
+            _row(session, rec)["readapts"] += 1
+    if not saw_refinement:
+        return None
+    table: Dict[str, Dict[str, Any]] = {}
+    for session, row in sorted(per.items()):
+        if not (row["refines"] or row["rollbacks"] or row["quarantines"]):
+            continue
+        scores = row.pop("scores")
+        if scores:
+            row["first_score"] = round(scores[0], 4)
+            row["last_score"] = round(scores[-1], 4)
+            row["best_score"] = round(min(scores), 4)
+        table[session[:12]] = row
+    return table or None
 
 
 def _tenants_from_access(
@@ -836,6 +901,22 @@ def render_human(report: Dict[str, Any]) -> str:
                 f"{row.get('p50_ms', '-'):>8} {row.get('p95_ms', '-'):>8} "
                 f"{row['page_ins']:>8} {row['evictions']:>6} "
                 f"{row['resident_bytes']:>10}  {outcomes}"
+            )
+    refinement = report.get("refinement")
+    if refinement:
+        lines.append("-- session refinement (events.jsonl) --")
+        lines.append(
+            f"{'session':<14} {'strategy':<10} {'refines':>7} {'rollbk':>6} "
+            f"{'quar':>4} {'readapt':>7} {'first':>8} {'last':>8} {'best':>8}"
+        )
+        for name, row in refinement.items():
+            lines.append(
+                f"{name:<14} {str(row.get('strategy') or '-')[:10]:<10} "
+                f"{row['refines']:>7} {row['rollbacks']:>6} "
+                f"{row['quarantines']:>4} {row['readapts']:>7} "
+                f"{row.get('first_score', '-'):>8} "
+                f"{row.get('last_score', '-'):>8} "
+                f"{row.get('best_score', '-'):>8}"
             )
     hbm = report.get("hbm")
     if hbm:

@@ -3,7 +3,7 @@
 The fast smoke runs a seeded in-process slice of the campaign — every
 invariant checked, subprocess episodes (rc=76 wedge, device-shrink) excluded
 for speed since tests/test_wedge_watchdog.py drills those bit-for-bit. The
-full soak (``-m slow``) runs ``scripts/chaos_soak.py --episodes 16 --seed 0``
+full soak (``-m slow``) runs ``scripts/chaos_soak.py --episodes 19 --seed 0``
 end to end and pins the one-JSON-line CLI contract."""
 
 import json
@@ -34,18 +34,23 @@ def test_episode_sampling_is_seeded_and_covers_every_seam():
         for f in ep.faults:
             seams.add(f.split("=", 1)[0])
     # serve episodes carry their seams inside _run_serve_episode
-    seams |= {"serving.dispatch", "serving.http"}
+    seams |= {"serving.dispatch", "serving.http", "serving.refine"}
     assert seams >= {
         "runner.step", "loader.episode", "checkpoint.read",
         "checkpoint.write", "serving.dispatch", "serving.http",
+        "serving.refine",
     }
+    # the full menu covers both ISSUE 17 refinement drills
+    kinds = {e.kind for e in menu}
+    assert {"serve-refine-rollback", "serve-refine-across-drain"} <= kinds
+    assert len(menu) == 19
     # deterministic in seed; jittered across seeds
-    a = [e.kind for e in sample_episodes(7, 17)]
-    b = [e.kind for e in sample_episodes(7, 17)]
+    a = [e.kind for e in sample_episodes(7, 19)]
+    b = [e.kind for e in sample_episodes(7, 19)]
     assert a == b
-    assert len(sample_episodes(0, 17, include_subprocess=False)) == 17
+    assert len(sample_episodes(0, 19, include_subprocess=False)) == 19
     assert not any(
-        e.subprocess for e in sample_episodes(0, 17, include_subprocess=False)
+        e.subprocess for e in sample_episodes(0, 19, include_subprocess=False)
     )
 
 
@@ -73,15 +78,16 @@ def test_chaos_smoke_campaign_all_invariants_green(toy_dataset, tmp_path):
 
 @pytest.mark.slow
 def test_full_chaos_soak_cli(tmp_path):
-    """The acceptance command: ``python scripts/chaos_soak.py --episodes 17
+    """The acceptance command: ``python scripts/chaos_soak.py --episodes 19
     --seed 0`` (one full menu pass, including the ISSUE 6 grow-back /
     SIGTERM-during-async-save episodes, the ISSUE 11 replica-death episode,
-    and the ISSUE 14 cross-process gateway drills) reports every invariant
-    green in ONE JSON line, rc 0."""
+    the ISSUE 14 cross-process gateway drills, and the ISSUE 17 refinement
+    rollback / across-drain drills) reports every invariant green in ONE
+    JSON line, rc 0."""
     proc = subprocess.run(
         [
             sys.executable, "scripts/chaos_soak.py",
-            "--episodes", "17", "--seed", "0",
+            "--episodes", "19", "--seed", "0",
             "--work-dir", str(tmp_path),
         ],
         cwd=REPO,
@@ -94,11 +100,12 @@ def test_full_chaos_soak_cli(tmp_path):
     assert len(lines) == 1, lines
     verdict = json.loads(lines[0])
     assert verdict["ok"] is True
-    assert verdict["episodes"] == 17
+    assert verdict["episodes"] == 19
     assert verdict["violations"] == []
     kinds = {r["kind"] for r in verdict["episode_results"]}
     assert {
         "device-grow-resume", "sigterm-during-async-save",
         "serve-replica-death", "serve-tenant-thrash", "gateway-kill9-backend",
         "gateway-drain-rehydrate", "gateway-rolling-restart",
+        "serve-refine-rollback", "serve-refine-across-drain",
     } <= kinds

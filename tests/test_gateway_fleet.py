@@ -261,6 +261,45 @@ def test_gateway_retry_with_exclusion_session_learning_and_access_log(tmp_path):
             srv.server_close()
 
 
+def test_gateway_refine_requests_follow_session_affinity():
+    """A refine (``/adapt`` with ``refine`` + ``session_id``) is SESSION
+    traffic: it keys on the session id — not the body hash, which would
+    scatter refines of one session across backends whenever the new support
+    set differs — and honors the session-table binding the adapt/refine
+    responses taught. Plain adapts (no ``refine`` field) keep the body-hash
+    key byte-identically."""
+    g = Gateway(["http://a", "http://b"], health_interval_s=30.0)
+    for backend in g.backends:
+        g.observe(backend, True, "ok")
+    sid = "sess-42"
+    refine_body = json.dumps(
+        {"refine": True, "session_id": sid, "x_support": [1], "y_support": [2]}
+    ).encode()
+    key, preferred = g.affinity_key("/adapt", refine_body)
+    assert key == sid and preferred is None  # rendezvous fallback pre-learn
+    # a DIFFERENT support payload for the same session -> the SAME key
+    other_body = json.dumps(
+        {"refine": True, "session_id": sid, "x_support": [9, 9], "y_support": [0]}
+    ).encode()
+    assert g.affinity_key("/adapt", other_body)[0] == sid
+    # refine responses ride /adapt and teach/update the binding the same
+    # way adapt responses do (adaptation_id IS the session id)
+    g._learn_from_response(
+        "/adapt",
+        json.dumps({"adaptation_id": sid, "refined": True}).encode(),
+        g.backends[1],
+    )
+    assert g.affinity_key("/adapt", refine_body)[1] is g.backends[1]
+    # the session's predicts share the learned binding
+    predict_body = json.dumps({"adaptation_id": sid, "x_query": [1]}).encode()
+    assert g.affinity_key("/predict", predict_body)[1] is g.backends[1]
+    # plain adapt: body-hash key, no session preference — unchanged
+    plain_body = json.dumps({"x_support": [1], "y_support": [2]}).encode()
+    key, preferred = g.affinity_key("/adapt", plain_body)
+    assert key != sid and preferred is None
+    g.close()
+
+
 def test_gateway_admission_control_sheds_429():
     s0, u0 = _spawn_fake("s0", lambda n, p: (200, {"probs": [[1.0]]}, None),
                          delay_s=0.6)
@@ -676,3 +715,15 @@ def test_cross_process_rolling_restart_under_load(tmp_path, fleet_template):
     come back warm (healthz-gated), and every non-200 the driver saw
     resolves to a gateway access line by request id."""
     _run_drill("gateway-rolling-restart", tmp_path, fleet_template)
+
+
+def test_cross_process_refined_session_survives_drain_and_gateway_kill(
+    tmp_path, fleet_template
+):
+    """ACCEPTANCE (ISSUE 17): a REFINED session survives a SIGTERM drain +
+    rehydrate (post-restart predictions bit-identical to the refined
+    weights, the next refine CONTINUES the lineage at refine_count 2) AND a
+    kill -9 of the gateway in front of it (a fresh gateway serves the same
+    session bit-identically and the lineage keeps counting) — never a
+    silently-reset session."""
+    _run_drill("serve-refine-across-drain", tmp_path, fleet_template)

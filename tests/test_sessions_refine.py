@@ -1,0 +1,430 @@
+"""Persistent adaptation sessions — guarded online refinement (ISSUE 17).
+
+Covers the refinement contract end to end at both layers:
+
+- engine: ``refine_batch`` continues the K-step rollout from cached fast
+  weights and matches the core ``refine_fast_weights`` primitive bit-for-bit
+  through the same predict program; batched == single.
+- frontend: commit / rollback / quarantine / re-adapt ladder (the cache is
+  untouched by a rollback — last-good predictions stay bit-identical), an
+  isolated regression never quarantines, lineage rides the SessionStore
+  spill -> rehydrate round trip, the refine-off surface stays absent, and
+  the sealed strict-mode guard sees ZERO outside-prewarm compiles under
+  mixed adapt/refine/predict traffic across two strategies.
+"""
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import Config, ServingConfig
+from howtotrainyourmamlpytorch_tpu.core import MAMLSystem
+from howtotrainyourmamlpytorch_tpu.data.synthetic import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.models import build_vgg
+from howtotrainyourmamlpytorch_tpu.resilience.faults import FaultInjector, FaultSpec
+from howtotrainyourmamlpytorch_tpu.serving.engine import AdaptationEngine
+from howtotrainyourmamlpytorch_tpu.serving.errors import (
+    SessionQuarantinedError,
+    UnknownAdaptationError,
+)
+from howtotrainyourmamlpytorch_tpu.serving.server import ServingFrontend
+
+_IMG = (14, 14, 1)
+
+
+def _config(**kwargs):
+    serving = kwargs.pop(
+        "serving",
+        ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=2,
+            refine_enabled=True,
+        ),
+    )
+    return Config(
+        num_classes_per_set=5,
+        num_samples_per_class=2,
+        num_target_samples=3,
+        batch_size=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        serving=serving,
+        **kwargs,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_system_state():
+    cfg = _config()
+    system = MAMLSystem(
+        cfg,
+        model=build_vgg(
+            _IMG, cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4
+        ),
+    )
+    return system, system.init_train_state()
+
+
+def _episode(seed):
+    batch = synthetic_batch(1, 5, 2, 3, _IMG, seed=seed)
+    x_s, y_s = batch["x_support"][0], batch["y_support"][0]
+    x_q = batch["x_target"][0].reshape((-1,) + _IMG)
+    return x_s, y_s, x_q
+
+
+def _refine_frontend(tiny_system_state, **serving_kwargs):
+    system, state = tiny_system_state
+    serving = ServingConfig(
+        support_buckets=[16], query_buckets=[16], max_batch_size=2,
+        refine_enabled=True, **serving_kwargs,
+    )
+    engine = AdaptationEngine(system, state, serving_cfg=serving)
+    return engine, ServingFrontend(engine)
+
+
+# ---------------------------------------------------------------------------
+# engine layer: refine continues the rollout from the cached weights
+# ---------------------------------------------------------------------------
+
+
+def test_engine_refine_matches_core_primitive(tiny_system_state):
+    """``engine.refine`` (the bucketed/batched serving program) must score
+    exactly like the core ``refine_fast_weights`` rollout it compiles."""
+    system, state = tiny_system_state
+    engine = AdaptationEngine(
+        system, state,
+        serving_cfg=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=2,
+            refine_enabled=True,
+        ),
+    )
+    x_s, y_s, x_q = _episode(seed=3)
+    fw = engine.adapt(x_s, y_s)
+    via_engine = engine.predict(engine.refine(fw, x_s, y_s), x_q)
+    # the core primitive takes the flattened [N, H, W, C] support the
+    # engine's batchers normalise to
+    x_flat, y_flat = engine._flatten_support(x_s, y_s)
+    core_fw = system.refine_fast_weights(state, fw, x_flat, y_flat)
+    via_core = engine.predict(core_fw, x_q)
+    np.testing.assert_allclose(
+        np.asarray(via_engine), np.asarray(via_core), atol=1e-5
+    )
+    # refine-vs-fresh parity: refining a K-step session on the SAME
+    # support is the same trajectory as one 2K-step rollout — at this
+    # checkpoint the LSLR schedule is per-step uniform, so continuing the
+    # rollout and stretching it coincide (within f32 tolerance)
+    k = engine.cfg.number_of_evaluation_steps_per_iter
+    fw_2k = system.adapt_fast_weights(state, x_flat, y_flat, num_steps=2 * k)
+    np.testing.assert_allclose(
+        np.asarray(via_engine), np.asarray(engine.predict(fw_2k, x_q)),
+        atol=1e-4,
+    )
+    # refining moved the weights: a refined session is NOT a fresh adapt
+    assert not np.allclose(
+        np.asarray(via_engine), np.asarray(engine.predict(fw, x_q))
+    )
+
+
+def test_engine_refine_batch_matches_single(tiny_system_state):
+    """A micro-batched refine flush returns exactly the per-session
+    results — same contract the adapt/predict batchers already pin."""
+    system, state = tiny_system_state
+    engine = AdaptationEngine(
+        system, state,
+        serving_cfg=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=4,
+            refine_enabled=True,
+        ),
+    )
+    episodes = [_episode(seed=s) for s in (11, 12)]
+    fws = [engine.adapt(x_s, y_s) for x_s, y_s, _ in episodes]
+    batched = engine.refine_batch(
+        [(fw, x_s, y_s) for fw, (x_s, y_s, _) in zip(fws, episodes)]
+    )
+    for fw, refined, (x_s, y_s, x_q) in zip(fws, batched, episodes):
+        single = engine.refine(fw, x_s, y_s)
+        np.testing.assert_allclose(
+            np.asarray(engine.predict(refined, x_q)),
+            np.asarray(engine.predict(single, x_q)),
+            atol=1e-5,
+        )
+
+
+def test_protonet_refine_recomputes_prototypes(tiny_system_state):
+    """ProtoNet sessions have no fast-weight rollout: a refine recomputes
+    the prototype table from the new support through the planned adapt
+    program. The first refine trains on the probe-carved subset; the
+    SECOND sees the full support again, so its table — and hence its
+    predictions — must be bit-identical to the fresh adapt's."""
+    system, state = tiny_system_state
+    engine = AdaptationEngine(
+        system, state,
+        serving_cfg=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=2,
+            strategies=["maml++", "protonet"], refine_enabled=True,
+            # streak semantics aren't under test here: a huge tolerance
+            # keeps the natural first-refine score wiggle (the candidate
+            # never trained on the probe points; the baseline did) from
+            # making this seed-dependent
+            refine_regress_tol=100.0,
+        ),
+    )
+    frontend = ServingFrontend(engine)
+    x_s, y_s, x_q = _episode(seed=5)
+    sid = frontend.adapt(x_s, y_s, strategy="protonet")["adaptation_id"]
+    before = np.asarray(frontend.predict(sid, x_q, strategy="protonet"))
+    r1 = frontend.refine(sid, x_s, y_s, strategy="protonet")
+    assert r1["refined"] and not r1["rolled_back"] and r1["refine_count"] == 1
+    r2 = frontend.refine(sid, x_s, y_s, strategy="protonet")
+    assert not r2["rolled_back"] and r2["refine_count"] == 2
+    after = np.asarray(frontend.predict(sid, x_q, strategy="protonet"))
+    np.testing.assert_array_equal(before, after)
+
+
+# ---------------------------------------------------------------------------
+# frontend layer: the guard ladder
+# ---------------------------------------------------------------------------
+
+
+def test_refine_commit_rollback_quarantine_readapt_ladder(tiny_system_state):
+    """The full guarded lifecycle: healthy refine commits; a poisoned
+    (nan-loss) refinement rolls back with the cache untouched; a burst of
+    consecutive regressions quarantines (409 on refine AND predict); the
+    only exit is an explicit re-adapt, which resets the lineage."""
+    # a huge regress tolerance makes the injected non-finite faults the
+    # ONLY rollback source — the ladder under test is streak bookkeeping,
+    # not the natural score wiggle of a random-init network
+    engine, frontend = _refine_frontend(
+        tiny_system_state, refine_quarantine_after=2, refine_regress_tol=100.0
+    )
+    x_s, y_s, x_q = _episode(seed=1)
+
+    with pytest.raises(UnknownAdaptationError):
+        frontend.refine("no-such-session", x_s, y_s)
+
+    sid = frontend.adapt(x_s, y_s)["adaptation_id"]
+    r1 = frontend.refine(sid, x_s, y_s)
+    assert r1["refined"] and not r1["rolled_back"]
+    assert r1["refine_count"] == 1 and r1["score"] is not None
+    p_good = np.asarray(frontend.predict(sid, x_q))
+
+    engine.injector = FaultInjector(
+        [FaultSpec.parse("serving.refine=nan-loss:times=3")]
+    )
+    r2 = frontend.refine(sid, x_s, y_s)
+    assert r2["rolled_back"] and r2["score"] is None
+    assert r2["refine_count"] == 1 and r2["consecutive_regressions"] == 1
+    # rollback discarded the candidate: last-good predictions bit-identical
+    np.testing.assert_array_equal(p_good, np.asarray(frontend.predict(sid, x_q)))
+
+    with pytest.raises(SessionQuarantinedError) as exc_info:
+        frontend.refine(sid, x_s, y_s)
+    assert exc_info.value.status == 409
+    assert exc_info.value.retry_after_s > 0
+    # a quarantined session refuses refine AND predict until re-adapted
+    with pytest.raises(SessionQuarantinedError):
+        frontend.predict(sid, x_q)
+    with pytest.raises(SessionQuarantinedError):
+        frontend.refine(sid, x_s, y_s)
+
+    engine.injector = FaultInjector()
+    info = frontend.adapt(x_s, y_s)
+    assert info["adaptation_id"] == sid and not info["cached"]
+    assert np.isfinite(np.asarray(frontend.predict(sid, x_q))).all()
+    r3 = frontend.refine(sid, x_s, y_s)
+    assert not r3["rolled_back"] and r3["refine_count"] == 1
+
+    m = frontend.metrics()
+    refine = m["sessions"]["refine"]
+    assert refine["refines"] == 2
+    assert refine["rollbacks"] == 2
+    assert refine["quarantines"] == 1
+    assert refine["readapts"] == 1
+    assert "refine_batcher" in m
+
+
+def test_isolated_rollback_does_not_quarantine(tiny_system_state):
+    """Quarantine is a CONSECUTIVE-regression breaker: a single rollback
+    followed by a healthy commit resets the streak, so isolated blips
+    never take a session out of service."""
+    engine, frontend = _refine_frontend(
+        tiny_system_state, refine_quarantine_after=2, refine_regress_tol=100.0
+    )
+    x_s, y_s, _ = _episode(seed=2)
+    sid = frontend.adapt(x_s, y_s)["adaptation_id"]
+    frontend.refine(sid, x_s, y_s)
+
+    for _ in range(2):  # rollback -> commit, twice: never two in a row
+        engine.injector = FaultInjector(
+            [FaultSpec.parse("serving.refine=nan-loss:times=1")]
+        )
+        r = frontend.refine(sid, x_s, y_s)
+        assert r["rolled_back"] and r["consecutive_regressions"] == 1
+        engine.injector = FaultInjector()
+        r = frontend.refine(sid, x_s, y_s)
+        assert not r["rolled_back"] and r["consecutive_regressions"] == 0
+
+    assert frontend.metrics()["sessions"]["refine"]["quarantines"] == 0
+
+
+# ---------------------------------------------------------------------------
+# lineage rides spill -> rehydrate
+# ---------------------------------------------------------------------------
+
+
+def test_spill_rehydrate_carries_lineage(tiny_system_state, tmp_path):
+    """A drained frontend spills refined sessions WITH their lineage; the
+    successor rehydrates them bit-identically and the next refine
+    CONTINUES the count instead of restarting a fresh lineage."""
+    system, state = tiny_system_state
+    serving = ServingConfig(
+        support_buckets=[16], query_buckets=[16], max_batch_size=2,
+        refine_enabled=True,
+    )
+    x_s, y_s, x_q = _episode(seed=4)
+
+    engine_a = AdaptationEngine(system, state, serving_cfg=serving)
+    engine_a.save_dir = str(tmp_path)
+    front_a = ServingFrontend(engine_a)
+    sid = front_a.adapt(x_s, y_s)["adaptation_id"]
+    assert front_a.refine(sid, x_s, y_s)["refine_count"] == 1
+    p_before = np.asarray(front_a.predict(sid, x_q))
+    drain = front_a.begin_drain(reason="test")
+    assert drain["spilled_sessions"] == 1, drain
+
+    engine_b = AdaptationEngine(system, state, serving_cfg=serving)
+    engine_b.save_dir = str(tmp_path)
+    front_b = ServingFrontend(engine_b)
+    assert front_b._session_stats.get("rehydrated") == 1
+    lineage = front_b._lineage_for(front_b._cache_key(sid, "maml++", None))
+    assert lineage is not None and lineage.refine_count == 1
+    assert lineage.probe is not None and lineage.scores
+    np.testing.assert_array_equal(p_before, np.asarray(front_b.predict(sid, x_q)))
+    assert front_b.refine(sid, x_s, y_s)["refine_count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# refine-off: the surface is absent, not dormant
+# ---------------------------------------------------------------------------
+
+
+def test_refine_disabled_surface_is_absent(tiny_system_state):
+    system, state = tiny_system_state
+    engine = AdaptationEngine(
+        system, state,
+        serving_cfg=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=2,
+        ),
+    )
+    frontend = ServingFrontend(engine)
+    x_s, y_s, _ = _episode(seed=6)
+    with pytest.raises(ValueError, match="refine_enabled"):
+        frontend.refine("x", x_s, y_s)
+    m = frontend.metrics()
+    assert "refine_batcher" not in m and "sessions" not in m
+    assert frontend.pool.replicas[0].refine_batcher is None
+
+
+# ---------------------------------------------------------------------------
+# obs_report: the per-session refinement table replays events.jsonl
+# ---------------------------------------------------------------------------
+
+
+def test_obs_report_refinement_table_from_events(tiny_system_state, tmp_path):
+    """scripts/obs_report.py builds the per-session refinement lifecycle
+    (commits / rollbacks / quarantines / re-adapts + score trend) from the
+    events the frontend actually writes."""
+    import importlib.util
+    import os
+
+    system, state = tiny_system_state
+    engine = AdaptationEngine(
+        system, state,
+        serving_cfg=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=2,
+            refine_enabled=True, refine_quarantine_after=2,
+            refine_regress_tol=100.0,
+        ),
+    )
+    frontend = ServingFrontend(engine, access_log_dir=str(tmp_path))
+    x_s, y_s, _ = _episode(seed=7)
+    sid = frontend.adapt(x_s, y_s)["adaptation_id"]
+    frontend.refine(sid, x_s, y_s)
+    frontend.refine(sid, x_s, y_s)
+    engine.injector = FaultInjector(
+        [FaultSpec.parse("serving.refine=nan-loss:times=3")]
+    )
+    r = frontend.refine(sid, x_s, y_s)
+    assert r["rolled_back"]
+    with pytest.raises(SessionQuarantinedError):
+        frontend.refine(sid, x_s, y_s)
+    engine.injector = FaultInjector()
+    frontend.adapt(x_s, y_s)  # re-adapt: the quarantine exit
+    frontend.close()
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report_mod",
+        os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                     "scripts", "obs_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    events, torn = mod._read_jsonl(str(tmp_path / "events.jsonl"))
+    assert torn == 0
+    table = mod._refinement_from_events(events)
+    assert table is not None and len(table) == 1
+    row = table[sid[:12]]
+    assert row["refines"] == 2 and row["rollbacks"] == 2
+    assert row["quarantines"] == 1 and row["readapts"] == 1
+    assert row["strategy"] == "maml++"
+    assert row["first_score"] is not None and row["last_score"] is not None
+    assert row["best_score"] <= max(row["first_score"], row["last_score"])
+    # refine-free events produce NO table (the section stays absent)
+    assert mod._refinement_from_events(
+        [{"event": "session_readapted", "session": "abc"}]
+    ) is None
+
+
+# ---------------------------------------------------------------------------
+# sealed guard: mixed refine traffic compiles NOTHING outside the prewarm
+# ---------------------------------------------------------------------------
+
+
+def test_sealed_guard_zero_compiles_under_mixed_refine_traffic(tmp_path):
+    """ACCEPTANCE (ISSUE 17): with the strict guard sealed after prewarm,
+    mixed adapt/refine/predict traffic across TWO strategies — including a
+    guard-probe score per refine — lowers zero new programs."""
+    cfg = _config(
+        strict_recompile_guard=True,
+        serving=ServingConfig(
+            support_buckets=[16], query_buckets=[16], max_batch_size=2,
+            strategies=["maml++", "anil"], refine_enabled=True,
+            refine_regress_tol=100.0,
+        ),
+    )
+    system = MAMLSystem(
+        cfg,
+        model=build_vgg(
+            _IMG, cfg.num_classes_per_set, num_stages=2, cnn_num_filters=4
+        ),
+    )
+    engine = AdaptationEngine(system, system.init_train_state())
+    summary = engine.prewarm(max_workers=1)
+    assert summary["errors"] == 0
+    sealed = engine.recompile_guard.snapshot()
+    assert sealed["prewarmed"]
+
+    frontend = ServingFrontend(engine)
+    x_s, y_s, x_q = _episode(seed=9)
+    for strategy in ("maml++", "anil"):
+        sid = frontend.adapt(x_s, y_s, strategy=strategy)["adaptation_id"]
+        for _ in range(2):
+            r = frontend.refine(sid, x_s, y_s, strategy=strategy)
+            assert r["refined"] and not r["rolled_back"]
+        probs = frontend.predict(sid, x_q, strategy=strategy)
+        assert np.isfinite(np.asarray(probs)).all()
+
+    snap = engine.recompile_guard.snapshot()
+    assert snap["violations"] == []
+    assert snap["lowerings"] == sealed["lowerings"], (
+        "mixed adapt/refine/predict traffic compiled outside the "
+        "prewarmed grid"
+    )
