@@ -108,6 +108,20 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
+    # BENCH_AUTOSCALE, same rc-2 contract, validated BEFORE anything heavy
+    # spins up: "1" runs the real fleet-surge cycle (subprocess gateway +
+    # backends + supervisor) and reports the control-plane timings in the
+    # same line. "" / "0" = the recipe exactly as before.
+    autoscale_knob = os.environ.get("BENCH_AUTOSCALE", "")
+    if autoscale_knob not in ("", "0", "1"):
+        print(
+            f"bench_serving: bad BENCH_AUTOSCALE {autoscale_knob!r} "
+            "(want '' / '0' / '1')",
+            file=sys.stderr,
+        )
+        return 2
+    bench_autoscale = autoscale_knob == "1"
+
     gateway_url = args.url or os.environ.get("BENCH_GATEWAY", "")
     if gateway_url:
         return _gateway_bench(args, gateway_url)
@@ -448,6 +462,52 @@ def main(argv=None) -> int:
             result["rollbacks"] = int(frontend.counters.get("refine_rollbacks"))
     finally:
         frontend.close()
+    # --- autoscale cycle (BENCH_AUTOSCALE=1): run the REAL fleet-surge
+    # drill (resilience/campaign.py — subprocess gateway + backends +
+    # supervisor) and lift the control-plane numbers off the supervisor's
+    # decision log: scale_up_settle_s = spawn -> /healthz past warming ->
+    # gateway admission (the warm gate the supervisor pays per scale-up),
+    # surge_recovery_s = supervisor engaged -> surge capacity admitted.
+    # The fields stay in the line either way so captures join.
+    result["scale_up_settle_s"] = None
+    result["surge_recovery_s"] = None
+    if bench_autoscale:
+        import tempfile
+
+        from howtotrainyourmamlpytorch_tpu.resilience import campaign
+
+        work = tempfile.mkdtemp(prefix="bench_autoscale_")
+        template = campaign.make_serving_run_dir(work, "template")
+        violations = campaign._run_gateway_episode(
+            campaign.Episode(kind="fleet-surge", mode="gateway",
+                             subprocess=True),
+            work_dir=work, template_run=template,
+        )
+        if violations:
+            # honest line: no timings rather than timings off a broken cycle
+            print(f"bench_serving: autoscale cycle violations: {violations}",
+                  file=sys.stderr)
+        else:
+            # the episode runs in its own chaos_fleet_surge_* subdir of work
+            drill_dirs = sorted(
+                d for d in os.listdir(work)
+                if d.startswith("chaos_fleet_surge_")
+            )
+            events = []
+            with open(os.path.join(
+                work, drill_dirs[-1], "supervisor_events.jsonl"
+            )) as f:
+                for line in f:
+                    try:
+                        events.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+            start = next(
+                e for e in events if e.get("event") == "supervisor_start"
+            )
+            up = next(e for e in events if e.get("event") == "scale_up")
+            result["scale_up_settle_s"] = up.get("settle_s")
+            result["surge_recovery_s"] = round(up["ts"] - start["ts"], 2)
     device_kind = str(jax.devices()[0].device_kind)
     mfu_value, mfu_reason = obs_costs.mfu(
         flops_per_query, queries_per_sec, device_kind

@@ -639,6 +639,89 @@ class ResilienceConfig:
 
 
 @dataclass
+class AutoscaleConfig:
+    """Fleet-supervisor knobs (``serving/autoscaler.py`` +
+    ``scripts/fleet_serve.py``; no reference equivalent). OFF by default:
+    with ``enabled`` false no supervisor exists, no fleet_state.json is
+    written, and gateway/backend behavior is byte-identical to a build
+    without the subsystem (test-pinned off-switch, like every sibling).
+
+    The supervisor itself is import-light (stdlib-only, yaml-free) and
+    takes these knobs as CLI flags — this block is their documented schema
+    home for run configs and presets; the defaults here are pinned equal to
+    ``autoscaler.Policy.DEFAULTS`` by test so the two can never drift.
+    See docs/OPERATIONS.md "Autoscaling" for the signal→decision table."""
+
+    enabled: bool = False
+    # fleet size clamps: scale-down never drains below min_backends;
+    # scale-up never spawns past max_backends (= the pre-provisioned slots)
+    min_backends: int = 1
+    max_backends: int = 4
+    # reactive loop: one control tick per poll_interval_s; up_polls
+    # consecutive breach ticks to scale up, down_polls consecutive clear
+    # ticks to scale down (hysteresis), each direction with its own cooldown
+    poll_interval_s: float = 2.0
+    up_polls: int = 2
+    down_polls: int = 5
+    cooldown_up_s: float = 10.0
+    cooldown_down_s: float = 60.0
+    # scale signals: max per-backend batcher queue depth, gateway shed/429
+    # rate over the tick, pager eviction delta, pager page-in p50 (0 = off)
+    queue_high: float = 8.0
+    queue_low: float = 1.0
+    shed_high: float = 0.05
+    evict_high: int = 5
+    page_in_p50_high_ms: float = 0.0
+    # spawn warm gate + graceful drain deadlines
+    warm_timeout_s: float = 300.0
+    warm_poll_s: float = 0.5
+    drain_timeout_s: float = 60.0
+    # crash-loop ladder: crash_max deaths inside crash_window_s quarantines
+    # the slot (never respawned hot); retries back off exponentially from
+    # backoff_base_s, capped at backoff_max_s
+    crash_max: int = 3
+    crash_window_s: float = 60.0
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 30.0
+    # predictive loop: re-forecast the traffic mix from access.jsonl every
+    # forecast_interval_s over a forecast_window_s sliding window; a retune
+    # is parked for the NEXT spawn when it cuts padding waste by at least
+    # retune_waste_improvement (absolute waste-fraction points)
+    forecast_interval_s: float = 30.0
+    forecast_window_s: float = 300.0
+    forecast_min_requests: int = 20
+    retune_waste_improvement: float = 0.10
+    max_buckets: int = 4
+
+    def __post_init__(self):
+        if self.min_backends < 0:
+            raise ValueError(
+                f"autoscale.min_backends must be >= 0, got {self.min_backends}"
+            )
+        if self.max_backends < max(1, self.min_backends):
+            raise ValueError(
+                f"autoscale.max_backends must be >= max(1, min_backends), "
+                f"got {self.max_backends}"
+            )
+        for name in ("up_polls", "down_polls", "crash_max"):
+            if getattr(self, name) < 1:
+                raise ValueError(
+                    f"autoscale.{name} must be >= 1, got {getattr(self, name)}"
+                )
+        for name in (
+            "poll_interval_s",
+            "warm_timeout_s",
+            "drain_timeout_s",
+            "backoff_base_s",
+            "crash_window_s",
+        ):
+            if getattr(self, name) <= 0:
+                raise ValueError(
+                    f"autoscale.{name} must be > 0, got {getattr(self, name)}"
+                )
+
+
+@dataclass
 class Config:
     # --- data provider (reference config.yaml:11-20,63-65) ---
     num_dataprovider_workers: int = 4
@@ -729,6 +812,8 @@ class Config:
         # resilience block does for its watchdog
         if isinstance(self.precision, dict):
             self.precision = PrecisionConfig(**self.precision)
+        if isinstance(self.autoscale, dict):
+            self.autoscale = AutoscaleConfig(**self.autoscale)
         if self.precision.fuse_conv_bn and not self.conv_via_patches:
             # the fused conv->BN epilogue IS a patches-GEMM epilogue; enable
             # the patches form rather than bounce the config back (the same
@@ -824,6 +909,11 @@ class Config:
     aot: AotConfig = field(default_factory=AotConfig)
     # --- mixed precision (ops/precision.py; ROADMAP item 3) ---
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)
+    # --- fleet autoscaling (serving/autoscaler.py; ISSUE 18). OFF by
+    # default: the import-light supervisor reads these as fleet_serve.py
+    # flags, never through this object — the block exists so run configs
+    # can DOCUMENT their fleet policy next to the serving block. ---
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     compute_dtype: str = "float32"  # or "bfloat16" for MXU-friendly compute
     remat_inner_steps: bool = True  # jax.checkpoint per inner step (SURVEY §5.7)
     # Rematerialization POLICY for the scanned inner step (core/maml.py
@@ -1067,8 +1157,8 @@ def _dataclass_from_dict(cls, data: Dict[str, Any]):
         if name not in data:
             continue
         value = data[name]
-        if name in ("dataset", "inner_optim", "parallel", "serving", "resilience", "observability", "aot", "precision"):
-            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig, "serving": ServingConfig, "resilience": ResilienceConfig, "observability": ObservabilityConfig, "aot": AotConfig, "precision": PrecisionConfig}[name]
+        if name in ("dataset", "inner_optim", "parallel", "serving", "resilience", "observability", "aot", "precision", "autoscale"):
+            sub_cls = {"dataset": DatasetConfig, "inner_optim": InnerOptimConfig, "parallel": ParallelConfig, "serving": ServingConfig, "resilience": ResilienceConfig, "observability": ObservabilityConfig, "aot": AotConfig, "precision": PrecisionConfig, "autoscale": AutoscaleConfig}[name]
             presets = {"dataset": DATASET_PRESETS, "inner_optim": INNER_OPTIM_PRESETS}.get(name, {})
             if isinstance(value, str):
                 if value not in presets:

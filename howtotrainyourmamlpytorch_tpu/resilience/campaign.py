@@ -286,6 +286,19 @@ def episode_menu(rng: np.random.RandomState) -> List[Episode]:
         # bit-identical, the next refine continuing the lineage, never a
         # silently-reset session
         Episode(kind="serve-refine-across-drain", mode="gateway", subprocess=True),
+        # self-healing fleet supervisor (ISSUE 18): scripts/fleet_serve.py
+        # owning backend lifecycle end to end. fleet-surge: load x4 against
+        # a slowed backend -> supervisor scales up into a pre-provisioned
+        # slot (healthz-gated) -> SLO recovers -> load stops -> scale-down
+        # gracefully drains (rc 0 observed) — zero dropped requests and a
+        # refined session's lineage intact across the whole cycle.
+        # fleet-crashloop: a die-on-spawn backend walks the bounded backoff
+        # ladder into quarantine (never respawned hot, fleet stays
+        # routable), and a supervisor kill -9'd mid-spawn restarts, adopts
+        # the live fleet from its write-ahead journal, and settles the
+        # interrupted spawn without double-spawning or orphaning.
+        Episode(kind="fleet-surge", mode="gateway", subprocess=True),
+        Episode(kind="fleet-crashloop", mode="gateway", subprocess=True),
     ]
     order = rng.permutation(len(menu))
     return [menu[i] for i in order]
@@ -1571,6 +1584,10 @@ def _run_gateway_episode(
             violations += _drill_rolling_restart(root, template_run, procs)
         elif ep.kind == "serve-refine-across-drain":
             violations += _drill_refine_across_drain(root, template_run, procs)
+        elif ep.kind == "fleet-surge":
+            violations += _drill_fleet_surge(root, template_run, procs)
+        elif ep.kind == "fleet-crashloop":
+            violations += _drill_fleet_crashloop(root, template_run, procs)
         else:
             violations.append(f"unknown gateway episode kind {ep.kind!r}")
     except Exception as exc:  # noqa: BLE001 — a drill crash is the finding
@@ -2090,6 +2107,513 @@ def _drill_refine_across_drain(root, template_run, procs) -> List[str]:
             f"refine through the new gateway did not continue the lineage: "
             f"{code} {body}"
         )
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# fleet supervisor drills (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def _spawn_fleet_supervisor(
+    root, name, state_path, gw_url, events_path, procs,
+    slots_path=None, **knobs
+):
+    """Fork scripts/fleet_serve.py; returns (proc, metrics_base_url)."""
+    port_file = os.path.join(root, f"{name}_port")
+    try:
+        os.remove(port_file)
+    except FileNotFoundError:
+        pass
+    argv = [
+        sys.executable, os.path.join(_REPO_ROOT, "scripts", "fleet_serve.py"),
+        "--state", state_path, "--gateway-url", gw_url,
+        "--events", events_path, "--metrics-port", "0",
+        "--port-file", port_file,
+    ]
+    if slots_path:
+        argv += ["--slots", slots_path]
+    for knob, val in knobs.items():
+        argv += ["--" + knob.replace("_", "-"), str(val)]
+    log_handle = open(os.path.join(root, f"{name}_stdout.log"), "ab")
+    proc = subprocess.Popen(
+        argv, cwd=_REPO_ROOT, env=_child_env(1),
+        stdout=log_handle, stderr=subprocess.STDOUT,
+    )
+    log_handle.close()
+    procs.append(proc)
+    port = _wait_port_file(port_file, proc, timeout_s=60.0)
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def _read_jsonl(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    return out
+
+
+def _read_fleet_state(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _wait_until(fn, timeout_s, desc, poll_s=0.2):
+    """Poll ``fn`` until it returns truthy; raise RuntimeError on timeout."""
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        val = fn()
+        if val:
+            return val
+        time.sleep(poll_s)
+    raise RuntimeError(f"timeout waiting for {desc}")
+
+
+def _stop_supervisor(sup_proc, violations, label):
+    """SIGTERM stops the control loop ONLY (rc 0, backends untouched)."""
+    if sup_proc.poll() is not None:
+        violations.append(
+            f"{label} supervisor exited early (rc {sup_proc.returncode})"
+        )
+        return
+    sup_proc.send_signal(15)
+    try:
+        rc = sup_proc.wait(timeout=120)
+        if rc != 0:
+            violations.append(f"{label} supervisor SIGTERM rc {rc} (want 0)")
+    except subprocess.TimeoutExpired:
+        violations.append(f"{label} supervisor never exited on SIGTERM")
+
+
+def _adopt_state_pids(state_path, procs):
+    """Track every pid the supervisor journaled so cleanup reaps them."""
+    state = _read_fleet_state(state_path) or {}
+    for slot in state.get("slots") or []:
+        if slot.get("pid"):
+            procs.append(_FakeProc(slot["pid"]))
+
+
+def _drill_fleet_surge(root, template_run, procs) -> List[str]:
+    """Traffic-adaptive autoscaling end to end: a slowed backend under
+    surging load breaches the queue signal -> the supervisor spawns the
+    pre-provisioned second slot (healthz-gated, gateway admits it) -> load
+    stops -> consecutive clear polls scale back down via a graceful drain
+    (rc 0 observed + reported). Zero dropped connections across the whole
+    cycle and a refined session's lineage survives it intact."""
+    violations: List[str] = []
+    template = template_run or make_serving_run_dir(root, "template")
+    run0 = make_serving_run_dir(
+        root, "b0", template=template,
+        serving_overrides={"refine_enabled": True},
+    )
+    run1 = make_serving_run_dir(
+        root, "b1", template=template,
+        serving_overrides={"refine_enabled": True},
+    )
+    port0, port1 = _free_port(), _free_port()
+    url0 = f"http://127.0.0.1:{port0}"
+    url1 = f"http://127.0.0.1:{port1}"
+    # slot 0: a real backend slowed by an injected 0.4s dispatch delay, so
+    # concurrent load genuinely queues (the scale-up trigger). The
+    # supervisor-spawned slot 1 inherits the supervisor's clean env — the
+    # added capacity is FAST, which is the point of scaling up.
+    env = {"HTYMP_FAULTS": "serving.dispatch=delay:delay_s=0.4,p=1.0"}
+    proc0, _ = spawn_serve_backend(run0, port=port0, env_extra=env)
+    procs.append(proc0)
+    _wait_http_ok(url0 + "/healthz", timeout_s=300.0, proc=proc0)
+    # reap on exit so a supervisor drain's pid-liveness probe sees death
+    threading.Thread(target=proc0.wait, daemon=True).start()
+    gw_logs = os.path.join(root, "gateway", "logs")
+    # BOTH slot urls are pre-registered: the gateway's backend list is
+    # static; the un-spawned slot simply stays OUT until the supervisor
+    # fills it
+    gw_proc, gw_url = spawn_gateway([url0, url1], gw_logs)
+    procs.append(gw_proc)
+    _wait_http_ok(gw_url + "/healthz", timeout_s=30.0, proc=gw_proc)
+    # a refined session whose lineage must ride out the whole cycle (seed 53:
+    # a support set whose refinement COMMITS under the score guard — the
+    # same payload the across-drain drill proves to refine_count 3)
+    support, query = _adapt_payload(53)
+    code, body, _ = _http_json(gw_url + "/adapt", support, timeout_s=60.0)
+    if code != 200:
+        return [f"warm adapt failed: {code} {body}"]
+    sid = body["adaptation_id"]
+    refine_body = {**support, "refine": True, "session_id": sid}
+    code, body, _ = _http_json(gw_url + "/adapt", refine_body, timeout_s=60.0)
+    if code != 200 or body.get("refine_count") != 1:
+        return [f"warm refine failed: {code} {body}"]
+    code, body, _ = _http_json(
+        gw_url + "/predict", {"adaptation_id": sid, "x_query": query},
+        timeout_s=60.0,
+    )
+    if code != 200:
+        return [f"warm predict failed: {code}"]
+    probs_refined = body["probs"]
+
+    slots = [
+        {"url": url0, "port": port0, "pid": proc0.pid,
+         "respawn": backend_spawn_argv(run0, port0), "cwd": _REPO_ROOT,
+         "log": os.path.join(run0, "serve_stdout.log"), "run_dir": run0},
+        {"url": url1, "port": port1,
+         "respawn": backend_spawn_argv(run1, port1), "cwd": _REPO_ROOT,
+         "log": os.path.join(run1, "serve_stdout.log"), "run_dir": run1},
+    ]
+    slots_path = os.path.join(root, "slots.json")
+    with open(slots_path, "w") as f:
+        json.dump(slots, f)
+    state_path = os.path.join(root, "fleet_state.json")
+    events_path = os.path.join(root, "supervisor_events.jsonl")
+    sup_proc, sup_url = _spawn_fleet_supervisor(
+        root, "supervisor", state_path, gw_url, events_path, procs,
+        slots_path=slots_path,
+        min_backends=1, max_backends=2, poll_interval_s=0.3,
+        up_polls=2, down_polls=4, cooldown_up_s=1.0, cooldown_down_s=2.0,
+        queue_high=2.0, queue_low=1.0, warm_timeout_s=300.0,
+        warm_poll_s=0.25, drain_timeout_s=90.0,
+    )
+    try:
+        code, sup_metrics, _ = _http_json(sup_url + "/metrics", timeout_s=10.0)
+        if code != 200 or not sup_metrics.get("supervisor"):
+            violations.append(f"supervisor /metrics broken: {code} {sup_metrics}")
+        # surge: concurrent predict streams against the 0.4s-dispatch
+        # backend — the batcher queue climbs past queue_high
+        stop = threading.Event()
+        outcomes: List[Any] = []
+        lock = threading.Lock()
+
+        def drive(seed0):
+            seed = seed0
+            aid = None
+            while not stop.is_set():
+                try:
+                    if aid is None:
+                        s, _ = _adapt_payload(seed % 40)
+                        c, b, _h = _http_json(gw_url + "/adapt", s,
+                                              timeout_s=60.0)
+                        if c == 200:
+                            aid = b.get("adaptation_id")
+                    else:
+                        _, q = _adapt_payload(seed % 40)
+                        c, b, _h = _http_json(
+                            gw_url + "/predict",
+                            {"adaptation_id": aid, "x_query": q},
+                            timeout_s=60.0,
+                        )
+                        if c == 404:
+                            aid = None  # displaced by membership change
+                except OSError:
+                    c = None
+                with lock:
+                    outcomes.append(c)
+                seed += 1
+
+        drivers = [
+            threading.Thread(target=drive, args=(1000 * (i + 1),), daemon=True)
+            for i in range(6)
+        ]
+        for t in drivers:
+            t.start()
+        # scale-up: the supervisor must spawn slot 1 and the gateway must
+        # admit it (healthz-gated past "warming")
+        try:
+            _wait_until(
+                lambda: _http_json(gw_url + "/metrics", timeout_s=10.0)[1]
+                .get("backends_in") == 2,
+                timeout_s=300.0, desc="scale-up to 2 backends",
+            )
+        except RuntimeError as exc:
+            violations.append(f"surge never scaled up: {exc}")
+        up_events = [e for e in _read_jsonl(events_path)
+                     if e.get("event") == "scale_up"]
+        if not up_events:
+            violations.append("no scale_up event in supervisor events.jsonl")
+        elif up_events[0].get("outcome") != "up" or not up_events[0].get("reason"):
+            violations.append(f"malformed scale_up event: {up_events[0]}")
+        # SLO recovery: with doubled capacity the fleet keeps answering —
+        # collect a post-scale-up window, then stop the surge
+        time.sleep(2.0)
+        with lock:
+            n_at_scaleup = len(outcomes)
+        _wait_until(
+            lambda: len(outcomes) >= n_at_scaleup + 8,
+            timeout_s=120.0, desc="post-scale-up traffic window",
+        )
+        stop.set()
+        for t in drivers:
+            t.join(timeout=90)
+        with lock:
+            seen = list(outcomes)
+        oks = sum(1 for c in seen if c == 200)
+        drops = sum(1 for c in seen if c is None)
+        if drops:
+            violations.append(
+                f"{drops} dropped connections during the surge cycle "
+                f"(of {len(seen)})"
+            )
+        if oks < 10:
+            violations.append(f"only {oks} 200s through the surge: {seen}")
+        # scale-down: clear polls -> graceful drain of the added backend,
+        # never below min_backends
+        try:
+            _wait_until(
+                lambda: any(e.get("event") == "scale_down"
+                            for e in _read_jsonl(events_path)),
+                timeout_s=120.0, desc="scale-down drain",
+            )
+        except RuntimeError as exc:
+            violations.append(f"never scaled back down: {exc}")
+        else:
+            (down,) = [e for e in _read_jsonl(events_path)
+                       if e.get("event") == "scale_down"][:1]
+            if down.get("slot") != 1:
+                violations.append(f"scale-down drained the wrong slot: {down}")
+            if down.get("drain_rc") != 0:
+                violations.append(
+                    f"drain rc not observed clean: {down.get('drain')} "
+                    f"rc {down.get('drain_rc')}"
+                )
+            try:
+                _wait_until(
+                    lambda: _http_json(gw_url + "/metrics", timeout_s=10.0)[1]
+                    .get("backends_in") == 1,
+                    timeout_s=60.0, desc="gateway sees the drained slot OUT",
+                )
+            except RuntimeError as exc:
+                violations.append(str(exc))
+        state = _read_fleet_state(state_path) or {}
+        up_slots = [s for s in state.get("slots", [])
+                    if s.get("state") == "up"]
+        if [s.get("slot") for s in up_slots] != [0]:
+            violations.append(
+                f"post-cycle fleet state wrong: {state.get('slots')}"
+            )
+        # the refined session's lineage is intact: the next refine
+        # CONTINUES at refine_count 2 and its pre-surge predictions held
+        code, body, _ = _http_json(
+            gw_url + "/predict", {"adaptation_id": sid, "x_query": query},
+            timeout_s=90.0,
+        )
+        if code != 200 or body.get("probs") != probs_refined:
+            violations.append(
+                f"refined session not intact after the cycle: {code}"
+            )
+        code, body, _ = _http_json(gw_url + "/adapt", refine_body,
+                                   timeout_s=90.0)
+        if code != 200 or body.get("refine_count") != 2:
+            violations.append(
+                f"refine lineage broken across the surge cycle: {code} "
+                f"{body.get('refine_count')}"
+            )
+        # supervisor frame: counters + marker for obs_top auto-detect
+        code, sup_metrics, _ = _http_json(sup_url + "/metrics", timeout_s=10.0)
+        if (
+            sup_metrics.get("counters", {}).get("scale_ups", 0) < 1
+            or sup_metrics.get("counters", {}).get("scale_downs", 0) < 1
+        ):
+            violations.append(
+                f"supervisor counters missing the cycle: "
+                f"{sup_metrics.get('counters')}"
+            )
+    finally:
+        _stop_supervisor(sup_proc, violations, "surge")
+        _adopt_state_pids(state_path, procs)
+    return violations
+
+
+def _drill_fleet_crashloop(root, template_run, procs) -> List[str]:
+    """Crash-safe control, both halves. (A) A die-on-spawn backend walks
+    the bounded exponential-backoff ladder into quarantine — never
+    respawned hot — while the fleet stays routable. (B) A supervisor
+    kill -9'd mid-spawn (intent + pid journaled, warm gate unfinished)
+    restarts, adopts the live fleet from the write-ahead journal, and
+    settles the interrupted spawn — same pid, no double-spawn, no orphan."""
+    violations: List[str] = []
+    template = template_run or make_serving_run_dir(root, "template")
+    run0 = make_serving_run_dir(root, "b0", template=template)
+    run2 = make_serving_run_dir(root, "b2", template=template)
+    port0, port1, port2 = _free_port(), _free_port(), _free_port()
+    url0 = f"http://127.0.0.1:{port0}"
+    url1 = f"http://127.0.0.1:{port1}"
+    url2 = f"http://127.0.0.1:{port2}"
+    proc0, _ = spawn_serve_backend(run0, port=port0)
+    procs.append(proc0)
+    _wait_http_ok(url0 + "/healthz", timeout_s=300.0, proc=proc0)
+    threading.Thread(target=proc0.wait, daemon=True).start()
+    gw_logs = os.path.join(root, "gateway", "logs")
+    gw_proc, gw_url = spawn_gateway([url0, url1, url2], gw_logs)
+    procs.append(gw_proc)
+    _wait_http_ok(gw_url + "/healthz", timeout_s=30.0, proc=gw_proc)
+
+    # --- leg A: crash-loop containment -------------------------------
+    slots_a = [
+        {"url": url0, "port": port0, "pid": proc0.pid,
+         "respawn": backend_spawn_argv(run0, port0), "cwd": _REPO_ROOT,
+         "log": os.path.join(run0, "serve_stdout.log"), "run_dir": run0},
+        # slot 1 dies the instant it spawns: the ladder's worst case
+        {"url": url1, "port": port1,
+         "respawn": [sys.executable, "-c", "import sys; sys.exit(1)"],
+         "cwd": _REPO_ROOT,
+         "log": os.path.join(root, "crashloop_stdout.log")},
+    ]
+    slots_a_path = os.path.join(root, "slots_a.json")
+    with open(slots_a_path, "w") as f:
+        json.dump(slots_a, f)
+    state_a = os.path.join(root, "fleet_state_a.json")
+    events_a = os.path.join(root, "supervisor_events_a.jsonl")
+    sup_a, _sup_a_url = _spawn_fleet_supervisor(
+        root, "supervisor_a", state_a, gw_url, events_a, procs,
+        slots_path=slots_a_path,
+        # min_backends 2 forces spawn attempts into the crash-looping slot
+        min_backends=2, max_backends=2, poll_interval_s=0.2,
+        crash_max=3, crash_window_s=60.0,
+        backoff_base_s=0.2, backoff_max_s=1.0, warm_timeout_s=30.0,
+    )
+    try:
+        try:
+            _wait_until(
+                lambda: any(e.get("event") == "quarantine"
+                            for e in _read_jsonl(events_a)),
+                timeout_s=60.0, desc="crash-loop quarantine",
+            )
+        except RuntimeError as exc:
+            violations.append(str(exc))
+        events = _read_jsonl(events_a)
+        crash_events = [e for e in events if e.get("event") == "spawn_crash"]
+        if len(crash_events) != 2:  # crash_max 3 = 2 backoffs + quarantine
+            violations.append(
+                f"expected 2 spawn_crash events before quarantine, got "
+                f"{len(crash_events)}"
+            )
+        backoffs = [e.get("backoff_s") for e in crash_events]
+        if backoffs != sorted(backoffs) or len(set(backoffs)) != len(backoffs):
+            violations.append(f"backoff ladder not increasing: {backoffs}")
+        # quarantined means NEVER respawned hot: the event log must go
+        # quiet for this slot
+        before = len(_read_jsonl(events_a))
+        time.sleep(2.0)
+        after_events = _read_jsonl(events_a)
+        new = [e for e in after_events[before:]
+               if e.get("slot") == 1 and e.get("event") != "supervisor_stop"]
+        if new:
+            violations.append(f"quarantined slot kept getting actions: {new}")
+        state = _read_fleet_state(state_a) or {}
+        slot1 = next((s for s in state.get("slots", [])
+                      if s.get("slot") == 1), {})
+        if slot1.get("state") != "quarantined":
+            violations.append(f"slot 1 not quarantined on disk: {slot1}")
+        # the fleet is still routable around the quarantined slot
+        s, _ = _adapt_payload(67)
+        code, _b, _h = _http_json(gw_url + "/adapt", s, timeout_s=60.0)
+        if code != 200:
+            violations.append(f"fleet not routable during crash-loop: {code}")
+    finally:
+        _stop_supervisor(sup_a, violations, "crashloop-A")
+
+    # --- leg B: kill -9 the supervisor mid-spawn ----------------------
+    slots_b = [
+        {"url": url0, "port": port0, "pid": proc0.pid,
+         "respawn": backend_spawn_argv(run0, port0), "cwd": _REPO_ROOT,
+         "log": os.path.join(run0, "serve_stdout.log"), "run_dir": run0},
+        {"url": url2, "port": port2,
+         "respawn": backend_spawn_argv(run2, port2), "cwd": _REPO_ROOT,
+         "log": os.path.join(run2, "serve_stdout.log"), "run_dir": run2},
+    ]
+    slots_b_path = os.path.join(root, "slots_b.json")
+    with open(slots_b_path, "w") as f:
+        json.dump(slots_b, f)
+    state_b = os.path.join(root, "fleet_state_b.json")
+    events_b = os.path.join(root, "supervisor_events_b.jsonl")
+    sup_b1, _ = _spawn_fleet_supervisor(
+        root, "supervisor_b1", state_b, gw_url, events_b, procs,
+        slots_path=slots_b_path,
+        min_backends=2, max_backends=2, poll_interval_s=0.2,
+        warm_timeout_s=300.0, warm_poll_s=0.25,
+    )
+
+    def _mid_spawn():
+        # the pid is journaled right after Popen, long before the warm
+        # gate settles — catching state "spawning" with a pid IS mid-spawn
+        state = _read_fleet_state(state_b) or {}
+        for slot in state.get("slots", []):
+            if slot.get("slot") == 1 and slot.get("state") == "spawning" \
+                    and slot.get("pid"):
+                return slot["pid"]
+        return None
+
+    try:
+        spawned_pid = _wait_until(_mid_spawn, timeout_s=120.0,
+                                  desc="mid-spawn journal window",
+                                  poll_s=0.02)
+    except RuntimeError as exc:
+        _stop_supervisor(sup_b1, violations, "crashloop-B1")
+        _adopt_state_pids(state_b, procs)
+        return violations + [str(exc)]
+    procs.append(_FakeProc(spawned_pid))
+    os.kill(sup_b1.pid, 9)  # the controller dies; the fleet must not care
+    sup_b1.wait(timeout=30)
+    state = _read_fleet_state(state_b) or {}
+    if not (state.get("intent") or {}).get("action") == "spawn":
+        violations.append(
+            f"journal lost the in-flight spawn intent: {state.get('intent')}"
+        )
+    try:
+        os.kill(spawned_pid, 0)
+    except ProcessLookupError:
+        violations.append("spawned backend died with its supervisor")
+    # restart: the journal (not the slots file) is the source of truth
+    sup_b2, _sup_b2_url = _spawn_fleet_supervisor(
+        root, "supervisor_b2", state_b, gw_url, events_b, procs,
+        min_backends=2, max_backends=2, poll_interval_s=0.2,
+        warm_timeout_s=300.0, warm_poll_s=0.25,
+    )
+    try:
+        try:
+            _wait_until(
+                lambda: (_read_fleet_state(state_b) or {}).get("intent") is None
+                and next(
+                    (s for s in (_read_fleet_state(state_b) or {}).get(
+                        "slots", [])
+                     if s.get("slot") == 1), {}
+                ).get("state") == "up",
+                timeout_s=300.0, desc="adopt-and-settle of the orphaned spawn",
+            )
+        except RuntimeError as exc:
+            violations.append(str(exc))
+        state = _read_fleet_state(state_b) or {}
+        slot1 = next((s for s in state.get("slots", [])
+                      if s.get("slot") == 1), {})
+        if slot1.get("pid") != spawned_pid:
+            violations.append(
+                f"adopt respawned instead of settling: pid {slot1.get('pid')}"
+                f" != journaled {spawned_pid} (double-spawn)"
+            )
+        rollforward = [e for e in _read_jsonl(events_b)
+                       if e.get("event") == "adopt_rollforward"]
+        if not any(e.get("outcome") == "spawn_settled" for e in rollforward):
+            violations.append(
+                f"no spawn_settled roll-forward event: {rollforward}"
+            )
+        try:
+            _wait_until(
+                lambda: _http_json(gw_url + "/metrics", timeout_s=10.0)[1]
+                .get("backends_in") == 2,
+                timeout_s=120.0, desc="gateway admits the adopted backend",
+            )
+        except RuntimeError as exc:
+            violations.append(str(exc))
+    finally:
+        _stop_supervisor(sup_b2, violations, "crashloop-B2")
+        _adopt_state_pids(state_b, procs)
     return violations
 
 

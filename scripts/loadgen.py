@@ -47,6 +47,36 @@ def _parse_stairs(text: str):
     return stairs
 
 
+def _apply_profile(stairs, text):
+    """Shaped-load profiles as DETERMINISTIC staircase transforms (no RNG —
+    the seeded schedule draw stays the only source of randomness, so the
+    same --seed still means a bit-identical schedule):
+
+      diurnal   trough->peak->trough day curve: the stairs followed by
+                their mirror ([4,8,16] -> [4,8,16,8,4])
+      surge:K   the stairs, then a K-fold spike of the peak, then recovery
+                back at the first stair ([4,8,16] surge:3 -> [4,8,16,48,4])
+                — the autoscaler drill shape (scale up, then back down)
+
+    ``--profile`` absent returns the stairs untouched (byte-identical
+    schedules; test-pinned)."""
+    if text is None:
+        return stairs
+    if text == "diurnal":
+        return stairs + stairs[-2::-1]
+    if text.startswith("surge:"):
+        try:
+            k = float(text.split(":", 1)[1])
+        except ValueError:
+            k = -1.0
+        if k > 0:
+            return stairs + [k * stairs[-1], stairs[0]]
+    raise SystemExit(
+        f"loadgen: --profile must be 'diurnal' or 'surge:K' (K > 0), "
+        f"got {text!r}"
+    )
+
+
 def _parse_tenant_skew(text: str, n_tenants: int):
     """'uniform' -> None (equal weights); 'zipf:a' -> 1/rank^a weights.
     Zipf is the realistic multi-tenant shape: a few hot tenants pin
@@ -72,6 +102,14 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--stairs", default="4,8,16",
         help="comma-separated offered loads (req/s), one staircase stage each",
+    )
+    parser.add_argument(
+        "--profile", default=None,
+        help="shaped-load schedule: 'diurnal' (stairs mirrored into a "
+        "trough->peak->trough day curve) or 'surge:K' (a K-fold spike of "
+        "the peak stair, then recovery) — a deterministic transform of "
+        "--stairs, so the same --seed stays bit-identical; absent = the "
+        "plain staircase, byte-identical to before",
     )
     parser.add_argument("--adapt-frac", type=float, default=0.25,
                         help="fraction of requests that are (uncached) adapts")
@@ -134,7 +172,7 @@ def main(argv=None) -> int:
         "bit-identical tenant assignment)",
     )
     args = parser.parse_args(argv)
-    stairs = _parse_stairs(args.stairs)
+    stairs = _apply_profile(_parse_stairs(args.stairs), args.profile)
     if args.tenants < 0:
         raise SystemExit(f"loadgen: --tenants must be >= 0, got {args.tenants}")
     if args.refine_frac < 0 or args.adapt_frac + args.refine_frac > 1:
@@ -337,6 +375,8 @@ def main(argv=None) -> int:
         adapt_frac=args.adapt_frac,
         replicas=n_replicas,
         schedule_digest=slo.schedule_digest(schedule),
+        # shaped-load runs say which shape produced the stairs
+        **({"profile": args.profile} if args.profile else {}),
         # external-process target: the gateway's per-backend outcome story
         # (X-Gateway-Backend tallies) — the multi-host twin of per_replica
         **(

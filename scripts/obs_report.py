@@ -201,7 +201,11 @@ def _hbm_from_session(session: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]
     }
 
 
-def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, Any]:
+def build_report(
+    run_dir: str,
+    xplane_dir: Optional[str] = None,
+    fleet_events: Optional[str] = None,
+) -> Dict[str, Any]:
     logs_dir = os.path.join(run_dir, "logs")
     tel_path = os.path.join(logs_dir, "telemetry.jsonl")
     report: Dict[str, Any] = {
@@ -209,11 +213,27 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
         "run_dir": run_dir,
         "run": os.path.basename(os.path.normpath(run_dir)),
     }
+    # fleet scaling decisions (ISSUE 18): an explicit --fleet-events path
+    # (the supervisor's events.jsonl lives next to fleet_state.json, not in
+    # a run dir) — replayed into a chronological decision table
+    fleet_records: List[Dict[str, Any]] = []
+    if fleet_events:
+        if os.path.exists(fleet_events):
+            fleet_records, torn_fleet = _read_jsonl(fleet_events)
+            if torn_fleet:
+                report["torn_fleet_event_lines"] = torn_fleet
+        else:
+            report["fleet_events_error"] = f"no such file: {fleet_events}"
     if not os.path.exists(tel_path):
         report["error"] = (
             "no logs/telemetry.jsonl — run predates the observability "
             "subsystem or had observability.enabled=false"
         )
+        # a supervisor's decision log needs no telemetry — degrade to the
+        # scaling table alone rather than dying on the missing file
+        scaling = _scaling_from_events(fleet_records)
+        if scaling is not None:
+            report["scaling"] = scaling
         return report
 
     snapshots, torn = _read_jsonl(tel_path)
@@ -417,6 +437,12 @@ def build_report(run_dir: str, xplane_dir: Optional[str] = None) -> Dict[str, An
         if tenants is not None:
             report["tenants"] = tenants
 
+    # the scaling table also replays off the run's own events.jsonl when a
+    # supervisor shared it (component == "supervisor" rows)
+    scaling = _scaling_from_events(fleet_records or event_records)
+    if scaling is not None:
+        report["scaling"] = scaling
+
     xplane_dir = xplane_dir or _profile_dir_from_config(run_dir)
     breakdown = _device_breakdown(xplane_dir)
     if breakdown is not None:
@@ -551,6 +577,45 @@ def _refinement_from_events(
             row["best_score"] = round(min(scores), 4)
         table[session[:12]] = row
     return table or None
+
+
+#: supervisor event names that ARE scaling decisions (serving/autoscaler.py
+#: _event); health-gate chatter (adopt, adopt_found_dead) stays out
+_SCALING_EVENTS = (
+    "supervisor_start", "scale_up", "scale_down", "backend_died",
+    "spawn_crash", "quarantine", "retune", "adopt_rollforward",
+    "supervisor_stop",
+)
+
+
+def _scaling_from_events(
+    events: List[Dict[str, Any]],
+) -> Optional[List[Dict[str, Any]]]:
+    """Chronological scaling-decision table (ISSUE 18) replayed off a fleet
+    supervisor's events.jsonl: each decision with the signal values that
+    triggered it, its outcome, and how long it took to settle — "why did
+    the fleet grow at 14:02, and how fast" is answerable after the fact.
+    Returns None when the stream holds no supervisor records at all."""
+    rows: List[Dict[str, Any]] = []
+    for rec in events:
+        if rec.get("component") != "supervisor":
+            continue
+        if rec.get("event") not in _SCALING_EVENTS:
+            continue
+        row = {
+            k: rec.get(k)
+            for k in ("ts", "event", "slot", "reason", "outcome", "settle_s",
+                      "drain", "drain_rc", "backoff_s", "crashes", "pid",
+                      "mode", "target", "adopted", "rolled_forward",
+                      "spilled_sessions", "overrides", "improvement",
+                      "waste_frac_before", "waste_frac_after", "ticks")
+            if rec.get(k) is not None
+        }
+        signals = rec.get("signals")
+        if isinstance(signals, dict) and signals:
+            row["signals"] = signals
+        rows.append(row)
+    return rows or None
 
 
 def _tenants_from_access(
@@ -730,10 +795,36 @@ def render_fleet_human(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def _render_scaling(report: Dict[str, Any], lines: List[str]) -> None:
+    scaling = report.get("scaling")
+    if not scaling:
+        return
+    lines.append(
+        "-- fleet scaling decisions (supervisor events.jsonl, "
+        "chronological) --"
+    )
+    for rec in scaling:
+        ts = rec.get("ts")
+        stamp = f"{ts:.3f}" if isinstance(ts, (int, float)) else "-"
+        signals = rec.get("signals") or {}
+        sig = " ".join(f"{k}={v}" for k, v in sorted(signals.items()))
+        detail = "  ".join(
+            f"{k}={v}" for k, v in sorted(rec.items())
+            if k not in ("ts", "event", "signals")
+        )
+        lines.append(
+            f"  {stamp}  {rec.get('event'):<18} {detail}"
+            + (f"  [{sig}]" if sig else "")
+        )
+
+
 def render_human(report: Dict[str, Any]) -> str:
     lines = [f"== run report: {report.get('run')} =="]
     if report.get("error"):
         lines.append(f"ERROR: {report['error']}")
+        # the scaling table survives a telemetry-free dir (fleet mode has
+        # no training run behind it)
+        _render_scaling(report, lines)
         return "\n".join(lines)
     lines.append(
         f"epochs {report['epochs']}  steps {report['steps']}  "
@@ -946,6 +1037,7 @@ def render_human(report: Dict[str, Any]) -> str:
                 if k not in ("ts", "event")
             )
             lines.append(f"  {stamp}  {rec.get('event'):<20} {detail}")
+    _render_scaling(report, lines)
     dev = report.get("device_breakdown")
     if dev and "error" not in dev:
         lines.append("-- device time (xplane) --")
@@ -993,6 +1085,13 @@ def main(argv=None) -> int:
         help="jax.profiler trace dir for the device-time join "
         "(default: the run config's profile_dir)",
     )
+    parser.add_argument(
+        "--fleet-events",
+        metavar="PATH",
+        help="a fleet supervisor's events.jsonl (scripts/fleet_serve.py "
+        "--events): adds the chronological scaling-decision table — works "
+        "against a telemetry-free dir too",
+    )
     args = parser.parse_args(argv)
     if args.exps_root:
         if not os.path.isdir(args.exps_root):
@@ -1014,7 +1113,10 @@ def main(argv=None) -> int:
     if not os.path.isdir(args.run_dir):
         print(f"obs_report: no such run dir: {args.run_dir}", file=sys.stderr)
         return _RC_USAGE
-    report = build_report(args.run_dir, xplane_dir=args.xplane_dir)
+    report = build_report(
+        args.run_dir, xplane_dir=args.xplane_dir,
+        fleet_events=args.fleet_events,
+    )
     if args.chrome_trace:
         src = report.get("trace_path")
         if src:

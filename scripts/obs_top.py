@@ -7,10 +7,13 @@ state, MFU, HBM headroom — the numbers an operator watches during a loadgen
 stair or a training run, without opening Perfetto or tailing three jsonl
 files. Two sources:
 
-- ``--url http://host:port`` — poll a live serving frontend's ``/metrics``
-  JSON (request latencies, batcher queue depths, shed/deadline/breaker
-  counters, cache hit rate, prewarm status, access-log line count). QPS is
-  the completed-request delta between consecutive polls.
+- ``--url http://host:port`` — poll a live ``/metrics`` JSON. The payload
+  is auto-detected: a serving frontend's (request latencies, batcher queue
+  depths, shed/deadline/breaker counters, cache hit rate — QPS is the
+  completed-request delta between consecutive polls), a gateway's (the
+  per-backend membership table), or a fleet supervisor's
+  (``scripts/fleet_serve.py``: per-backend slot state, the last scaling
+  decision + reason, hysteresis streaks and cooldown timers).
 - ``--run-dir exps/<run>`` — tail ``logs/telemetry.jsonl`` (the hub's
   latest snapshot: step-phase percentiles, episodes/s, MFU, HBM headroom,
   watchdog beat age).
@@ -253,6 +256,55 @@ def gateway_frame(
     }
 
 
+def supervisor_frame(
+    metrics: Dict[str, Any], prev: Optional[Dict[str, Any]], interval_s: float
+) -> Dict[str, Any]:
+    """One console frame from a fleet SUPERVISOR /metrics payload
+    (scripts/fleet_serve.py): the controller's view — per-backend slot
+    state, the last scaling decision + its reason, hysteresis streaks, and
+    the cooldown timers gating the next move."""
+    ticks = int((metrics.get("counters") or {}).get("ticks", 0))
+    ticks_per_s = None
+    if prev is not None and prev.get("_ticks") is not None and interval_s > 0:
+        ticks_per_s = round(max(0, ticks - prev["_ticks"]) / interval_s, 2)
+    last = metrics.get("last_decision") or {}
+    return {
+        "source": "supervisor",
+        "uptime_s": metrics.get("uptime_s"),
+        "gateway_url": metrics.get("gateway_url"),
+        "running": metrics.get("running"),
+        "target": metrics.get("target"),
+        "min_backends": metrics.get("min_backends"),
+        "max_backends": metrics.get("max_backends"),
+        "ticks_per_s": ticks_per_s,
+        "streaks": metrics.get("streaks"),
+        "cooldowns": metrics.get("cooldowns"),
+        "signals": metrics.get("signals"),
+        "last_decision": {
+            k: last.get(k)
+            for k in ("event", "slot", "reason", "outcome", "settle_s",
+                      "drain_rc", "backoff_s")
+            if last.get(k) is not None
+        } or None,
+        "intent": metrics.get("intent"),
+        "pending_overrides": metrics.get("pending_overrides"),
+        "counters": metrics.get("counters"),
+        "slots": [
+            {
+                "slot": s.get("slot"),
+                "state": s.get("state"),
+                "pid": s.get("pid"),
+                "crashes_in_window": s.get("crashes_in_window"),
+                "next_spawn_in_s": s.get("next_spawn_in_s"),
+                "url": s.get("url"),
+            }
+            for s in metrics.get("slots") or []
+            if isinstance(s, dict)
+        ],
+        "_ticks": ticks,
+    }
+
+
 def _min_headroom(memory: Optional[Dict[str, Any]]) -> Optional[float]:
     """Tightest per-device HBM headroom fraction in a MemoryWatermarks
     snapshot (it pre-aggregates ``headroom_frac_min``; fall back to the
@@ -333,6 +385,63 @@ def render(frame: Dict[str, Any]) -> str:
                 f"routed {_fmt(b.get('routed'))}  "
                 f"retried_away {_fmt(b.get('retried_away'))}  "
                 f"flaps {_fmt(b.get('flaps'))}  {b.get('url')}"
+            )
+        return "\n".join(lines)
+    if frame["source"] == "supervisor":
+        counters = frame.get("counters") or {}
+        lines.append(
+            f"superv   up {_fmt(frame['uptime_s'])}s   "
+            f"fleet {_fmt(frame['running'])}/{_fmt(frame['target'])} "
+            f"(min {_fmt(frame['min_backends'])} max {_fmt(frame['max_backends'])})   "
+            f"ticks/s {_fmt(frame['ticks_per_s'])}   "
+            f"gw {_fmt(frame['gateway_url'])}"
+        )
+        streaks = frame.get("streaks") or {}
+        cooldowns = frame.get("cooldowns") or {}
+        lines.append(
+            f"control  streak up {_fmt(streaks.get('up'))} "
+            f"down {_fmt(streaks.get('down'))}   "
+            f"cooldown up {_fmt(cooldowns.get('up_remaining_s'))}s "
+            f"down {_fmt(cooldowns.get('down_remaining_s'))}s   "
+            f"ups {_fmt(counters.get('scale_ups'))}  "
+            f"downs {_fmt(counters.get('scale_downs'))}  "
+            f"quarantines {_fmt(counters.get('quarantines'))}"
+        )
+        signals = frame.get("signals") or {}
+        if signals:
+            parts = "  ".join(
+                f"{k} {_fmt(v)}" for k, v in sorted(signals.items())
+            )
+            lines.append(f"signals  {parts}")
+        last = frame.get("last_decision")
+        if last:
+            parts = "  ".join(
+                f"{k} {_fmt(last[k])}" for k in
+                ("event", "slot", "reason", "outcome", "settle_s",
+                 "drain_rc", "backoff_s")
+                if last.get(k) is not None
+            )
+            lines.append(f"decision {parts}")
+        intent = frame.get("intent")
+        if intent:
+            lines.append(
+                f"intent   {_fmt(intent.get('action'))} "
+                f"slot {_fmt(intent.get('slot'))} (IN FLIGHT)"
+            )
+        if frame.get("pending_overrides"):
+            lines.append(
+                "prewarm  " + "  ".join(frame["pending_overrides"])
+            )
+        for s in frame.get("slots") or []:
+            state = (s.get("state") or "?").upper()
+            extras = ""
+            if s.get("crashes_in_window"):
+                extras += f"  crashes {_fmt(s['crashes_in_window'])}"
+            if s.get("next_spawn_in_s") is not None:
+                extras += f"  next_spawn_in {_fmt(s['next_spawn_in_s'])}s"
+            lines.append(
+                f"  slot{_fmt(s.get('slot'))} {state:<11} "
+                f"pid {_fmt(s.get('pid')):<9}{extras}  {s.get('url')}"
             )
         return "\n".join(lines)
     if frame["source"] == "serving":
@@ -434,6 +543,9 @@ def build_frame(
         if metrics.get("gateway"):
             # a gateway's /metrics: membership per backend, not one engine
             return gateway_frame(metrics, prev, args.interval)
+        if metrics.get("supervisor"):
+            # a fleet supervisor's /metrics: the CONTROLLER, not a backend
+            return supervisor_frame(metrics, prev, args.interval)
         return serving_frame(metrics, prev, args.interval)
     path = os.path.join(args.run_dir, "logs", "telemetry.jsonl")
     snapshot = _tail_jsonl_last(path)

@@ -6,11 +6,15 @@ Usage:
     python scripts/rolling_restart.py --fleet fleet.json \
         [--drain-timeout-s 60] [--warm-timeout-s 300] [--settle-s 0]
 
-``fleet.json`` is a list of backends, in restart order::
+``fleet.json`` is either the legacy list form, in restart order::
 
     [{"url": "http://127.0.0.1:8101", "pid": 12345,
       "respawn": ["python", "scripts/serve.py", "exps/run", "--port", "8101"]},
      ...]
+
+or the shared version-1 ``fleet_state.json`` schema the autoscaling
+supervisor journals (see ``serving/fleetctl.py``) — the same file drives
+both tools, so a roll can restart a supervisor-built fleet verbatim.
 
 Per backend the script: (1) sends SIGTERM — the backend flips /healthz to
 ``draining`` (the gateway stops routing new work to it), completes in-flight
@@ -24,20 +28,17 @@ One JSON line per backend on stdout + a final summary line; rc 0 iff every
 backend came back healthy.
 
 Import-light BY CONTRACT (no jax, no package import) so it runs on a
-gateway-only host: file-path-loads ``exit_codes.py`` with a literal
-fallback. See docs/OPERATIONS.md "Multi-host serving".
+gateway-only host: the drain/spawn/liveness primitives live in
+``serving/fleetctl.py`` (stdlib-only, file-path-loaded here).
+See docs/OPERATIONS.md "Multi-host serving".
 """
 
 import argparse
 import importlib.util
 import json
 import os
-import signal
-import subprocess
 import sys
 import time
-import urllib.error
-import urllib.request
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _PKG = os.path.join(_REPO_ROOT, "howtotrainyourmamlpytorch_tpu")
@@ -50,141 +51,18 @@ def _load_by_path(name: str, path: str):
     return module
 
 
-try:
-    _exit_codes = _load_by_path("htymp_exit_codes", os.path.join(_PKG, "exit_codes.py"))
-    RC_OK, RC_USAGE = _exit_codes.OK, _exit_codes.USAGE
-    RC_DRAIN_DEADLINE = _exit_codes.DRAIN_DEADLINE
-except Exception:  # standalone copy of scripts/: the historical literals hold
-    RC_OK, RC_USAGE, RC_DRAIN_DEADLINE = 0, 2, 77
+_fleetctl = _load_by_path(
+    "htymp_fleetctl", os.path.join(_PKG, "serving", "fleetctl.py")
+)
+RC_OK, RC_USAGE = _fleetctl.RC_OK, _fleetctl.RC_USAGE
+RC_DRAIN_DEADLINE = _fleetctl.RC_DRAIN_DEADLINE
 
-
-def _healthz(url: str, timeout_s: float = 3.0):
-    """-> (code, body dict) or (None, {}) when unreachable."""
-    try:
-        with urllib.request.urlopen(
-            url.rstrip("/") + "/healthz", timeout=timeout_s
-        ) as resp:
-            return resp.status, json.loads(resp.read())
-    except urllib.error.HTTPError as exc:
-        try:
-            return exc.code, json.loads(exc.read())
-        except ValueError:
-            return exc.code, {}
-    except (urllib.error.URLError, OSError, ValueError):
-        return None, {}
-
-
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True
-    return True
-
-
-def _wait_pid_gone(pid: int, timeout_s: float, poll_s: float = 0.2):
-    """-> (gone, rc). ``rc`` is the drain exit code when observable — only
-    for pids that are OUR children (a roll restarting backends a previous
-    roll respawned); for a supervisor-owned pid it stays None and the
-    backend's own logs/events carry the drain verdict."""
-    rc = None
-    end = time.monotonic() + timeout_s
-    while time.monotonic() < end:
-        # reap if it is our child (spawned this session); harmless otherwise
-        try:
-            reaped, status = os.waitpid(pid, os.WNOHANG)
-            if reaped == pid:
-                rc = os.waitstatus_to_exitcode(status)
-        except ChildProcessError:
-            pass
-        if not _pid_alive(pid):
-            return True, rc
-        time.sleep(poll_s)
-    return not _pid_alive(pid), rc
-
-
-def _wait_healthy(url: str, timeout_s: float, poll_s: float = 0.5) -> bool:
-    """Poll /healthz until 200 (past 'warming'/'draining') or timeout."""
-    end = time.monotonic() + timeout_s
-    while time.monotonic() < end:
-        code, _ = _healthz(url)
-        if code == 200:
-            return True
-        time.sleep(poll_s)
-    return False
-
-
-def restart_backend(
-    entry: dict,
-    drain_timeout_s: float,
-    warm_timeout_s: float,
-    log=lambda m: print(m, file=sys.stderr, flush=True),
-) -> dict:
-    """Drain + respawn + warm-gate ONE backend; returns its verdict row."""
-    url, pid = entry["url"], int(entry["pid"])
-    row = {"url": url, "old_pid": pid}
-    t0 = time.monotonic()
-    log(f"rolling_restart: draining {url} (pid {pid})")
-    try:
-        os.kill(pid, signal.SIGTERM)
-    except ProcessLookupError:
-        row["drain"] = "already_gone"
-    else:
-        row["drain"] = "sigterm_sent"
-    gone, drain_rc = _wait_pid_gone(pid, drain_timeout_s)
-    if not gone:
-        # a backend that ignores its drain deadline is wedged — escalate so
-        # the roll can continue; its sessions (if spilled) still rehydrate
-        log(f"rolling_restart: {url} pid {pid} outlived drain timeout — SIGKILL")
-        try:
-            os.kill(pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        _wait_pid_gone(pid, 10.0)
-        row["drain"] = "killed_after_timeout"
-    elif drain_rc is not None:
-        # the drain verdict, when observable (our own child): rc 0 clean,
-        # rc 77 = drain deadline exceeded — the replica's last seconds were
-        # lossy; report it, the roll continues (the backend is gone either
-        # way and the respawn rehydrates whatever was spilled)
-        row["drain_rc"] = drain_rc
-        if drain_rc == RC_DRAIN_DEADLINE:
-            row["drain"] = "deadline_exceeded"
-            log(f"rolling_restart: {url} drain exceeded its deadline (rc "
-                f"{drain_rc}) — lossy last seconds")
-    row["drain_s"] = round(time.monotonic() - t0, 2)
-    respawn = entry.get("respawn")
-    if not respawn:
-        row["ok"] = False
-        row["error"] = "no respawn command"
-        return row
-    log(f"rolling_restart: respawning {url}")
-    # the respawned backend must NOT inherit this script's stdout/stderr:
-    # it outlives us, and an inherited pipe would keep the caller's
-    # capture open forever. Its output goes to entry["log"] or /dev/null.
-    log_path = entry.get("log")
-    out = open(log_path, "ab") if log_path else subprocess.DEVNULL
-    try:
-        proc = subprocess.Popen(
-            respawn,
-            cwd=entry.get("cwd") or None,
-            stdin=subprocess.DEVNULL,
-            stdout=out,
-            stderr=subprocess.STDOUT if log_path else subprocess.DEVNULL,
-        )
-    finally:
-        if log_path:
-            out.close()
-    row["new_pid"] = proc.pid
-    t1 = time.monotonic()
-    healthy = _wait_healthy(url, warm_timeout_s)
-    row["warm_s"] = round(time.monotonic() - t1, 2)
-    row["ok"] = healthy
-    if not healthy:
-        row["error"] = f"/healthz not 200 within {warm_timeout_s}s"
-    return row
+# re-exported for callers/tests that reach through this module
+_healthz = _fleetctl.healthz
+_pid_alive = _fleetctl.pid_alive
+_wait_pid_gone = _fleetctl.wait_pid_gone
+_wait_healthy = _fleetctl.wait_healthy
+restart_backend = _fleetctl.restart_backend
 
 
 def rolling_restart(
@@ -218,7 +96,8 @@ def rolling_restart(
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--fleet", required=True,
-                        help="JSON file: [{url, pid, respawn: [argv...]}, ...]")
+                        help="JSON file: legacy [{url, pid, respawn}, ...] "
+                        "list or version-1 fleet_state.json")
     parser.add_argument("--drain-timeout-s", type=float, default=60.0,
                         help="max wait for a SIGTERM'd backend to exit "
                         "(should exceed serving.drain_deadline_s)")
@@ -228,13 +107,18 @@ def main(argv=None) -> int:
                         help="pause between backends (let caches re-warm)")
     args = parser.parse_args(argv)
     try:
-        with open(args.fleet) as f:
-            fleet = json.load(f)
+        state = _fleetctl.load_fleet_state(args.fleet)
     except (OSError, ValueError) as exc:
         print(f"rolling_restart: bad --fleet file: {exc}", file=sys.stderr)
         return RC_USAGE
-    if not isinstance(fleet, list) or not fleet:
-        print("rolling_restart: --fleet must be a non-empty JSON list",
+    # quarantined slots are radioactive (crash-looped under the supervisor)
+    # and empty slots have nothing to restart — roll only live backends
+    fleet = [
+        s for s in state["slots"]
+        if s.get("pid") and s.get("state") not in ("quarantined", "down")
+    ]
+    if not fleet:
+        print("rolling_restart: no restartable backends in --fleet",
               file=sys.stderr)
         return RC_USAGE
     verdict = rolling_restart(

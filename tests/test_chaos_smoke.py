@@ -3,7 +3,7 @@
 The fast smoke runs a seeded in-process slice of the campaign — every
 invariant checked, subprocess episodes (rc=76 wedge, device-shrink) excluded
 for speed since tests/test_wedge_watchdog.py drills those bit-for-bit. The
-full soak (``-m slow``) runs ``scripts/chaos_soak.py --episodes 19 --seed 0``
+full soak (``-m slow``) runs ``scripts/chaos_soak.py --episodes 21 --seed 0``
 end to end and pins the one-JSON-line CLI contract."""
 
 import json
@@ -40,17 +40,21 @@ def test_episode_sampling_is_seeded_and_covers_every_seam():
         "checkpoint.write", "serving.dispatch", "serving.http",
         "serving.refine",
     }
-    # the full menu covers both ISSUE 17 refinement drills
+    # the full menu covers the ISSUE 17 refinement drills and the ISSUE 18
+    # fleet-supervisor drills
     kinds = {e.kind for e in menu}
-    assert {"serve-refine-rollback", "serve-refine-across-drain"} <= kinds
-    assert len(menu) == 19
+    assert {
+        "serve-refine-rollback", "serve-refine-across-drain",
+        "fleet-surge", "fleet-crashloop",
+    } <= kinds
+    assert len(menu) == 21
     # deterministic in seed; jittered across seeds
-    a = [e.kind for e in sample_episodes(7, 19)]
-    b = [e.kind for e in sample_episodes(7, 19)]
+    a = [e.kind for e in sample_episodes(7, 21)]
+    b = [e.kind for e in sample_episodes(7, 21)]
     assert a == b
-    assert len(sample_episodes(0, 19, include_subprocess=False)) == 19
+    assert len(sample_episodes(0, 21, include_subprocess=False)) == 21
     assert not any(
-        e.subprocess for e in sample_episodes(0, 19, include_subprocess=False)
+        e.subprocess for e in sample_episodes(0, 21, include_subprocess=False)
     )
 
 
@@ -78,16 +82,17 @@ def test_chaos_smoke_campaign_all_invariants_green(toy_dataset, tmp_path):
 
 @pytest.mark.slow
 def test_full_chaos_soak_cli(tmp_path):
-    """The acceptance command: ``python scripts/chaos_soak.py --episodes 19
+    """The acceptance command: ``python scripts/chaos_soak.py --episodes 21
     --seed 0`` (one full menu pass, including the ISSUE 6 grow-back /
     SIGTERM-during-async-save episodes, the ISSUE 11 replica-death episode,
-    the ISSUE 14 cross-process gateway drills, and the ISSUE 17 refinement
-    rollback / across-drain drills) reports every invariant green in ONE
-    JSON line, rc 0."""
+    the ISSUE 14 cross-process gateway drills, the ISSUE 17 refinement
+    rollback / across-drain drills, and the ISSUE 18 fleet surge /
+    crash-loop drills) reports every invariant green in ONE JSON line,
+    rc 0."""
     proc = subprocess.run(
         [
             sys.executable, "scripts/chaos_soak.py",
-            "--episodes", "19", "--seed", "0",
+            "--episodes", "21", "--seed", "0",
             "--work-dir", str(tmp_path),
         ],
         cwd=REPO,
@@ -100,7 +105,7 @@ def test_full_chaos_soak_cli(tmp_path):
     assert len(lines) == 1, lines
     verdict = json.loads(lines[0])
     assert verdict["ok"] is True
-    assert verdict["episodes"] == 19
+    assert verdict["episodes"] == 21
     assert verdict["violations"] == []
     kinds = {r["kind"] for r in verdict["episode_results"]}
     assert {
@@ -108,4 +113,5 @@ def test_full_chaos_soak_cli(tmp_path):
         "serve-replica-death", "serve-tenant-thrash", "gateway-kill9-backend",
         "gateway-drain-rehydrate", "gateway-rolling-restart",
         "serve-refine-rollback", "serve-refine-across-drain",
+        "fleet-surge", "fleet-crashloop",
     } <= kinds

@@ -361,7 +361,7 @@ def test_gateway_and_rolling_restart_scripts_are_import_light():
         "runpy.run_path(sys.argv[1], run_name='not_main')\n"
         "print('LOADED', sys.argv[1])\n"
     )
-    for script in ("gateway.py", "rolling_restart.py"):
+    for script in ("gateway.py", "rolling_restart.py", "fleet_serve.py"):
         proc = subprocess.run(
             [sys.executable, "-c", probe, os.path.join("scripts", script)],
             cwd=REPO, capture_output=True, text=True, timeout=60,
@@ -667,6 +667,67 @@ def test_obs_top_renders_gateway_membership_per_backend():
     assert "b1" in rendered and "OUT" in rendered and "draining" in rendered
 
 
+def test_obs_top_auto_detects_supervisor_and_renders_controller_frame():
+    """ISSUE 18: a fleet supervisor's /metrics (the {"supervisor": true}
+    marker) renders the CONTROLLER frame — per-backend slot state, the last
+    decision + its reason, and the live cooldown timers."""
+    obs_top = _load_obs_top()
+    metrics = {
+        "supervisor": True,
+        "uptime_s": 33.1,
+        "gateway_url": "http://127.0.0.1:9000",
+        "running": 2,
+        "target": 2,
+        "min_backends": 1,
+        "max_backends": 4,
+        "streaks": {"up": 1, "down": 0},
+        "cooldowns": {"up_remaining_s": 7.5, "down_remaining_s": 0.0},
+        "signals": {"queue_depth_max": 9.0, "shed_rate": 0.0},
+        "last_decision": {
+            "ts": 123.0, "event": "scale_up", "component": "supervisor",
+            "slot": 1, "reason": "queue_depth_max 9.0 > 8.0",
+            "outcome": "up", "settle_s": 4.2,
+        },
+        "pending_overrides": ["serving.support_buckets=[2]"],
+        "counters": {"ticks": 10, "scale_ups": 1, "scale_downs": 0,
+                     "quarantines": 0},
+        "intent": None,
+        "slots": [
+            {"slot": 0, "url": "http://127.0.0.1:9101", "state": "up",
+             "pid": 100, "crashes_in_window": 0, "next_spawn_in_s": None},
+            {"slot": 1, "url": "http://127.0.0.1:9102", "state": "up",
+             "pid": 101, "crashes_in_window": 0, "next_spawn_in_s": None},
+            {"slot": 2, "url": "http://127.0.0.1:9103", "state": "quarantined",
+             "pid": None, "crashes_in_window": 3, "next_spawn_in_s": 12.5},
+        ],
+    }
+
+    class _Args:
+        url = "http://sup"
+        timeout_s = 1.0
+        interval = 2.0
+        run_dir = None
+
+    # build_frame auto-detects the marker (monkeypatch the fetch)
+    obs_top._fetch_metrics = lambda url, timeout_s: metrics
+    prev = obs_top.build_frame(_Args, None)
+    assert prev["source"] == "supervisor" and prev["ticks_per_s"] is None
+    frame = obs_top.build_frame(
+        _Args, {**prev, "_ticks": 4}
+    )
+    assert frame["ticks_per_s"] == 3.0  # (10 - 4) / 2.0
+    rendered = obs_top.render(frame)
+    assert "2/2" in rendered and "min 1 max 4" in rendered
+    assert "scale_up" in rendered and "queue_depth_max 9.0 > 8.0" in rendered
+    assert "cooldown up 7.5s" in rendered
+    assert "QUARANTINED" in rendered and "crashes 3" in rendered
+    assert "next_spawn_in 12.5s" in rendered
+    assert "prewarm  serving.support_buckets=[2]" in rendered
+    # the JSON surface drops the _-prefixed delta bookkeeping
+    public = {k: v for k, v in frame.items() if not k.startswith("_")}
+    assert "_ticks" not in public and public["running"] == 2
+
+
 # ---------------------------------------------------------------------------
 # THE cross-process drills (subprocess gateway + real serve backends)
 # ---------------------------------------------------------------------------
@@ -727,3 +788,27 @@ def test_cross_process_refined_session_survives_drain_and_gateway_kill(
     session bit-identically and the lineage keeps counting) — never a
     silently-reset session."""
     _run_drill("serve-refine-across-drain", tmp_path, fleet_template)
+
+
+def test_cross_process_fleet_surge_autoscale_cycle(tmp_path, fleet_template):
+    """ACCEPTANCE (ISSUE 18): scripts/fleet_serve.py closes the scaling
+    loop against a REAL fleet — surging load on a slowed backend breaches
+    the queue signal, the supervisor spawns the pre-provisioned second slot
+    (healthz-gated, gateway admits it), the SLO recovers, and when the load
+    stops the added backend is gracefully drained (rc 0 observed in the
+    scale_down event) back to min_backends. Zero dropped connections across
+    the cycle and a refined session's lineage intact (refine_count 2)."""
+    _run_drill("fleet-surge", tmp_path, fleet_template)
+
+
+def test_cross_process_fleet_crashloop_and_supervisor_kill9(
+    tmp_path, fleet_template
+):
+    """ACCEPTANCE (ISSUE 18): crash-safe control. A die-on-spawn backend
+    walks the bounded backoff ladder (increasing backoffs in events.jsonl)
+    into quarantine — never respawned hot, fleet still routable. Then a
+    supervisor kill -9'd mid-spawn (intent + pid write-ahead journaled,
+    warm gate unfinished) restarts, adopts the live fleet from
+    fleet_state.json, and settles the interrupted spawn with the SAME pid —
+    no double-spawn, no orphan — until the gateway admits the backend."""
+    _run_drill("fleet-crashloop", tmp_path, fleet_template)

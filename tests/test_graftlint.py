@@ -972,6 +972,35 @@ def test_self_gate_covers_tenancy_paths_explicitly():
     )
 
 
+def test_self_gate_covers_autoscaler_paths_explicitly():
+    """The fleet supervisor (ISSUE 18) sits inside the self-gate on its
+    own terms: the supervisor mutates slot/counter state from the control
+    loop AND the /metrics handler thread (GL201 territory), fleetctl's
+    drain rows consume the rc registry (GL301 territory), and both CLIs
+    are import-light exit-code consumers — zero unsuppressed findings even
+    if the top-level path list is ever restructured."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "autoscaler.py"
+                ),
+                os.path.join(
+                    "howtotrainyourmamlpytorch_tpu", "serving", "fleetctl.py"
+                ),
+                os.path.join("scripts", "fleet_serve.py"),
+                os.path.join("scripts", "rolling_restart.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in autoscaler paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_catches_an_introduced_true_positive(tmp_path):
     """End-to-end: drop one fixture true positive next to real package code
     and the CLI must exit 1 with a GL id on stdout."""

@@ -294,6 +294,57 @@ def test_loadgen_cli_print_schedule_bit_identical():
     assert payload["digest"]["n"] == len(payload["schedule"])
 
 
+def test_loadgen_profile_transform_deterministic_and_off_by_default():
+    """ISSUE 18: --profile is a DETERMINISTIC stairs transform (no RNG) —
+    diurnal mirrors the staircase into a trough->peak->trough day curve,
+    surge:K appends a K-fold spike of the peak + recovery; absent leaves
+    the stairs (and therefore the schedule bytes) untouched; junk is a
+    usage error before any backend spins up."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "t_loadgen", os.path.join(REPO_ROOT, "scripts", "loadgen.py")
+    )
+    lg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lg)
+
+    assert lg._apply_profile([4.0, 8.0, 16.0], None) == [4.0, 8.0, 16.0]
+    assert lg._apply_profile([4.0, 8.0, 16.0], "diurnal") == [
+        4.0, 8.0, 16.0, 8.0, 4.0]
+    assert lg._apply_profile([4.0], "diurnal") == [4.0]
+    assert lg._apply_profile([4.0, 8.0, 16.0], "surge:3") == [
+        4.0, 8.0, 16.0, 48.0, 4.0]
+    for junk in ("weird", "surge:", "surge:0", "surge:-2", "SURGE:3"):
+        with pytest.raises(SystemExit):
+            lg._apply_profile([4.0], junk)
+
+    # end to end over the CLI: same seed + same profile = bit-identical
+    # stdout; the profile visibly reshapes the schedule vs the plain stairs
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def run(extra):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO_ROOT, "scripts", "loadgen.py"),
+                "--seed", "0", "--duration-s", "5", "--print-schedule",
+                *extra,
+            ],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr
+        return proc.stdout
+
+    surge_a, surge_b = run(["--profile", "surge:3"]), run(["--profile", "surge:3"])
+    assert surge_a == surge_b
+    plain = json.loads(run([]))
+    surged = json.loads(surge_a)
+    assert surged["digest"] != plain["digest"]
+    # default stairs [4,8,16] -> surge:3 adds two stages (spike + recovery)
+    assert max(r["stair"] for r in surged["schedule"]) == 4
+    assert max(r["stair"] for r in plain["schedule"]) <= 2
+
+
 def test_slo_report_schema_and_sustained_headline():
     stairs = [2.0, 4.0]
     schedule = slo.generate_schedule(3, 10.0, stairs)
