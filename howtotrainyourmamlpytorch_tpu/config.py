@@ -596,6 +596,15 @@ class ResilienceConfig:
     # request_deadline_s before the client hears anything, so a hung device
     # should go fast-503 after fewer events than instant raising failures
     breaker_timeout_threshold: int = 3
+    # --- graftsan lock-discipline sanitizer (tools/graftsan) ---
+    # Arm the runtime lock-order/held-across-blocking detector: every lock
+    # built through the utils/locks.py factories becomes an instrumented
+    # wrapper reporting graftsan_violation events (events.jsonl +
+    # scripts/graftsan_report.py). Off (default) the factories return plain
+    # stdlib primitives — bit-identical behavior, zero overhead. The
+    # HTYMP_GRAFTSAN=1 env var arms process-wide without a config (how the
+    # chaos campaign arms its subprocess episodes).
+    sanitizer: bool = False
     # --- wedge watchdog (resilience/watchdog.py) ---
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     # --- fault injection (resilience/faults.py; spec grammar documented

@@ -16,6 +16,9 @@ that must stay import-light before the backend is known-up
 file path instead of importing the (heavy) package.
 """
 
+# graftlint: import-light — file-path-loaded before the backend is known-up
+# (GL213 gates the closure)
+
 # --- generic CLI codes ----------------------------------------------------
 #: completed / all invariants held
 OK = 0

@@ -154,6 +154,21 @@ class ExperimentRunner:
         self._variants_seen: set = set()
 
         # --- resilience (config.py::ResilienceConfig; resilience/ package) ---
+        # graftsan lock-discipline sanitizer: armed here (before the loader
+        # pool / watchdog / any serving construction) so every lock built
+        # through the utils/locks.py factories is instrumented; violations
+        # land in this run's events.jsonl as graftsan_violation records
+        if (
+            getattr(cfg.resilience, "sanitizer", False)
+            or os.environ.get("HTYMP_GRAFTSAN") == "1"
+        ):
+            try:
+                from tools.graftsan import runtime as _graftsan_runtime
+
+                _graftsan_runtime.arm()
+                _graftsan_runtime.add_sink(self.events.append)
+            except ImportError:  # packaged without tools/: sanitizer off
+                pass
         # fault injector (inert unless cfg.resilience.faults / HTYMP_FAULTS
         # name a drill), NaN-ladder counters, preemption flag
         self._injector = injector_from(cfg.resilience)

@@ -41,6 +41,8 @@ from typing import Any, Callable, Dict, List, Optional
 from ..utils.compcache import active_cache_dir, cache_entry_count
 from .costs import program_cost, program_memory
 
+from ..utils.locks import san_lock
+
 
 def program_name(key: Any) -> str:
     """Canonical ledger name for a program-cache key: tuples join with
@@ -61,7 +63,7 @@ class CompileLedger:
         self._clock = clock
         self._wall_clock = wall_clock
         self.session = session
-        self._lock = threading.Lock()
+        self._lock = san_lock("CompileLedger._lock")
         # program name -> aggregate {builds, lower_s, compile_s, total_s,
         # cache_hits, errors, flops, bytes_accessed}
         self._programs: Dict[str, Dict[str, Any]] = {}
@@ -225,7 +227,7 @@ class LedgerWrapped:
         self._ledger = ledger
         self.program = program
         self._jitted = jitted_fn
-        self._lock = threading.Lock()
+        self._lock = san_lock("LedgerWrapped._lock")
         self._by_sig: Dict[Any, Callable] = {}
         self._clock = ledger._clock
 

@@ -41,6 +41,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..utils.locks import san_lock
+
 #: W3C traceparent: version "00" - 16-byte trace id - 8-byte parent span id
 #: - 2-hex flags (bit 0 = sampled). All-zero ids are invalid per spec.
 _TRACEPARENT_RE = re.compile(
@@ -179,7 +181,7 @@ class AccessLog:
         self.path = self._log.path
         self.sample = float(sample)
         self._wall_clock = wall_clock
-        self._lock = threading.Lock()
+        self._lock = san_lock("AccessLog._lock")
         self.lines = 0
         self.sampled_out = 0
 

@@ -22,6 +22,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils.locks import san_lock
+
 DEFAULT_WINDOW = 2048
 
 
@@ -42,7 +44,7 @@ class MetricsRegistry:
         if default_window < 1:
             raise ValueError(f"default_window must be >= 1, got {default_window}")
         self.default_window = int(default_window)
-        self._lock = threading.Lock()
+        self._lock = san_lock("MetricsRegistry._lock")
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, Any] = {}
         self._hists: Dict[str, _Histogram] = {}

@@ -39,6 +39,8 @@ import numpy as np
 
 from .context import format_traceparent, new_request_context, read_access_log
 
+from ..utils.locks import san_lock
+
 #: how many worst request ids a failing stair names in the SLO report —
 #: enough to grep their flow traces, small enough to stay one JSON line
 DEFAULT_WORST_K = 5
@@ -230,7 +232,7 @@ class HttpFrontend:
         self._unavailable = ServiceUnavailableError
         self._deadline = DeadlineExceededError
         self._unknown = UnknownAdaptationError
-        self._lock = threading.Lock()
+        self._lock = san_lock("HttpFrontend._lock")
         self._by_backend: Dict[str, Dict[str, int]] = {}
         self.breaker = _NullBreaker()
         self.hub = _NullHub()
@@ -339,7 +341,7 @@ class _Results:
     verdicts here; aggregation happens after the run)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san_lock("_Results._lock")
         self._rows: List[Dict[str, Any]] = []
 
     def add(
@@ -441,7 +443,7 @@ def run_load(
     # which the regression guard correctly rolls back — a rollback storm
     # is the fault drill's job, not the load test's.
     ids: Dict[Optional[str], List[tuple]] = {None: []}
-    ids_lock = threading.Lock()
+    ids_lock = san_lock("slo.run_load.ids_lock")
 
     # -- warmup: compile + seed the adaptation pool (excluded). One predict
     # per distinct scheduled query size: a cold bucket compile inside a

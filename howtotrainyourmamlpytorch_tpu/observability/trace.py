@@ -24,6 +24,17 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+try:
+    from ..utils.locks import san_lock
+except ImportError:  # file-path-loaded (trace_merge toy fleets run this
+    # module standalone): take the repo-root import, else a plain primitive
+    try:
+        from tools.graftsan.runtime import san_lock
+    except ImportError:
+
+        def san_lock(site=None):
+            return threading.Lock()
+
 
 class _NullSpan:
     """Shared no-op context manager: the disabled tracer's ``span()`` must
@@ -133,7 +144,7 @@ class SpanTracer:
         self._clock = clock
         self._epoch = clock()
         self.epoch_unix = wall_clock()
-        self._lock = threading.Lock()
+        self._lock = san_lock("SpanTracer._lock")
         self._ring: deque = deque(maxlen=self.capacity)
         self._local = threading.local()
         self.dropped = 0

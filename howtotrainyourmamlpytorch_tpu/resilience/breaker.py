@@ -49,6 +49,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ..utils.locks import san_lock
+
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
 
@@ -85,7 +87,7 @@ class CircuitBreaker:
         self.half_open_probes = int(half_open_probes)
         self.timeout_threshold = int(timeout_threshold)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = san_lock("CircuitBreaker._lock")
         self._state = CLOSED
         self._consecutive_failures = 0
         self._consecutive_timeouts = 0

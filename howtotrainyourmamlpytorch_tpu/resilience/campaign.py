@@ -50,6 +50,8 @@ import numpy as np
 
 from .. import exit_codes
 
+from ..utils.locks import san_lock
+
 #: Exit codes with documented semantics (docs/OPERATIONS.md rc table) — the
 #: rc-discipline invariant checks against the central registry, so a new code
 #: added there is automatically accepted (and documented) here.
@@ -1760,7 +1762,7 @@ def _drill_drain_rehydrate(root, template_run, procs) -> List[str]:
     # in-flight load: 3 concurrent predicts (0.5s dispatch each, serialized
     # by the worker) — then SIGTERM lands mid-flight
     results: List[Any] = []
-    lock = threading.Lock()
+    lock = san_lock("campaign._drill_drain_rehydrate.lock")
 
     def one_predict():
         try:
@@ -1864,7 +1866,7 @@ def _drill_rolling_restart(root, template_run, procs) -> List[str]:
     # background driver: steady adapt/predict mix; record every outcome
     stop = threading.Event()
     outcomes: List[Any] = []
-    lock = threading.Lock()
+    lock = san_lock("campaign._drill_rolling_restart.lock")
 
     def drive():
         seed = 500
@@ -2289,7 +2291,7 @@ def _drill_fleet_surge(root, template_run, procs) -> List[str]:
         # backend — the batcher queue climbs past queue_high
         stop = threading.Event()
         outcomes: List[Any] = []
-        lock = threading.Lock()
+        lock = san_lock("campaign._drill_fleet_surge.lock")
 
         def drive(seed0):
             seed = seed0
@@ -2658,16 +2660,43 @@ def run_campaign(
     seed: int = 0,
     data_root: Optional[str] = None,
     include_subprocess: bool = True,
+    sanitize: bool = False,
     log: Callable[[str], None] = lambda m: print(m, file=sys.stderr, flush=True),
 ) -> Dict[str, Any]:
     """Run a seeded chaos campaign and return the one-line JSON verdict
     (also what ``scripts/chaos_soak.py`` prints). ``include_subprocess=False``
     drops the fork-a-fresh-interpreter episodes (rc=76 wedge, device-shrink)
-    for fast in-process smokes; the CLI keeps them."""
+    for fast in-process smokes; the CLI keeps them. ``sanitize=True`` arms
+    the graftsan lock-discipline sanitizer (``tools/graftsan``) for the
+    whole campaign — in-process episodes through the armed runtime,
+    subprocess episodes through ``HTYMP_GRAFTSAN=1`` + a shared
+    ``HTYMP_GRAFTSAN_LOG`` file under ``work_dir`` — and folds any
+    lock-order / held-across-blocking / thread-leak findings into the
+    verdict as campaign violations."""
     from ..config import save_config
     from ..experiment import ExperimentRunner
 
     os.makedirs(work_dir, exist_ok=True)
+    graftsan_runtime = None
+    graftsan_log = os.path.join(work_dir, "graftsan.jsonl")
+    graftsan_prior_env: Dict[str, Optional[str]] = {}
+    if sanitize:
+        from tools.graftsan import runtime as graftsan_runtime
+
+        # children inherit via _child_env's os.environ copy; the log file
+        # is the only channel out of a fork-a-fresh-interpreter episode
+        graftsan_prior_env = {
+            k: os.environ.get(k)
+            for k in ("HTYMP_GRAFTSAN", "HTYMP_GRAFTSAN_LOG")
+        }
+        os.environ["HTYMP_GRAFTSAN"] = "1"
+        os.environ["HTYMP_GRAFTSAN_LOG"] = graftsan_log
+        try:
+            os.remove(graftsan_log)
+        except FileNotFoundError:
+            pass
+        graftsan_runtime.arm()
+        graftsan_runtime.reset()
     data_root = data_root or make_toy_dataset(os.path.join(work_dir, "toy_data"))
     exp_root = os.path.join(work_dir, "exps")
     plan = sample_episodes(seed, episodes, include_subprocess)
@@ -2810,12 +2839,69 @@ def run_campaign(
         for v in ep_viol:
             violations.append({"episode": i, "kind": ep.kind, "violation": v})
 
+    sanitizer_summary: Optional[Dict[str, Any]] = None
+    if sanitize:
+        # restore the caller's env FIRST (a failed parse must not leave the
+        # whole test process implicitly armed), then fold findings in
+        for key, prior in graftsan_prior_env.items():
+            if prior is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prior
+        graftsan_runtime.disarm()
+        # the log file is the union: in-process _record writes it too (the
+        # env var was set), so it covers subprocess episodes for free
+        records: List[Dict[str, Any]] = []
+        torn = 0
+        try:
+            with open(graftsan_log) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except ValueError:
+                        torn += 1  # a crash mid-write tears at most one line
+        except FileNotFoundError:
+            pass
+        by_kind: Dict[str, int] = {}
+        for rec in records:
+            kind = rec.get("kind", "?")
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+        sanitizer_summary = {
+            "armed": True,
+            "violations": len(records),
+            "by_kind": by_kind,
+            "torn_lines": torn,
+            "log": graftsan_log,
+        }
+        for rec in records:
+            detail = (
+                " -> ".join(
+                    s for s in (rec.get("site_a"), rec.get("site_b")) if s
+                )
+                or rec.get("blocking")
+                or rec.get("context")
+                or ""
+            )
+            violations.append(
+                {
+                    "episode": None,
+                    "kind": "graftsan",
+                    "violation": f"graftsan {rec.get('kind')}: {detail}".rstrip(
+                        ": "
+                    ),
+                }
+            )
+
     verdict = {
         "campaign": "chaos_soak",
         "seed": seed,
         "episodes": len(results),
         "ok": not violations,
         "violations": violations,
+        "sanitizer": sanitizer_summary,
         "invariants": [
             "rc in {0,3,75,76}",
             "latest-or-fallback checkpoint loads",
@@ -2829,4 +2915,9 @@ def run_campaign(
         "episode_results": results,
         "elapsed_s": round(time.time() - t0, 1),
     }
+    if sanitize:
+        verdict["invariants"].append(
+            "graftsan: zero lock-order / blocking-under-lock / thread-leak "
+            "violations across every episode"
+        )
     return verdict

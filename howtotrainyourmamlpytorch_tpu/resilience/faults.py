@@ -66,6 +66,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..utils.locks import san_lock
+
 KINDS = ("raise", "corrupt-bytes", "nan-loss", "delay", "sigterm")
 
 #: The registered seam names — the single source of truth for everything
@@ -159,7 +161,7 @@ class FaultInjector:
         # batcher workers, ThreadingHTTPServer handlers) sharing one
         # injector: the call counters must be atomic or nth/times/p triggers
         # lose their deterministic-replay guarantee exactly at those seams
-        self._lock = threading.Lock()
+        self._lock = san_lock("FaultInjector._lock")
         self._calls: Dict[str, int] = {}
         # (site, kind) -> times fired; the observability surface for drills
         self.fired: Dict[str, int] = {}

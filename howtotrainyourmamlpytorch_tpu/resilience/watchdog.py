@@ -49,6 +49,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .. import exit_codes
 
+from ..utils.locks import san_lock
+
 #: The wedge exit code's contract (mirrors PREEMPTED/EX_TEMPFAIL): restartable,
 #: but the harness should gate on the backend before relaunch. Single source
 #: of truth: ``exit_codes.WEDGED``; re-exported here for existing callers.
@@ -106,7 +108,7 @@ class HeartbeatWatchdog:
         self._progress_fn = progress_fn
         self._pending_fn = pending_fn
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = san_lock("HeartbeatWatchdog._lock")
         self._armed = False
         self._stopped = False
         self._fired = False

@@ -44,6 +44,7 @@ pid probe) is injectable so tests/test_autoscaler.py drives the whole
 decision matrix on a fake clock with zero subprocesses.
 """
 
+# graftlint: import-light — file-path-loaded by scripts/fleet_serve.py on supervisor hosts (GL213 gates the closure)
 import json
 import os
 import signal as _signal
@@ -54,6 +55,13 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
+
+try:  # graftsan lock factory — needs the repo root on sys.path
+    from tools.graftsan.runtime import san_lock
+except ImportError:  # gateway-only host: sanitizer off, stdlib primitive
+
+    def san_lock(site=None):
+        return threading.Lock()
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
@@ -225,8 +233,8 @@ class Supervisor:
         self.port_pid = port_pid
         self.log = log
 
-        self._lock = threading.Lock()
-        self._events_lock = threading.Lock()
+        self._lock = san_lock("Supervisor._lock")
+        self._events_lock = san_lock("Supervisor._events_lock")
         self.state: Dict[str, Any] = {"slots": [], "intent": None, "target": 0}
         self.counters = {
             "ticks": 0, "scale_ups": 0, "scale_downs": 0, "crashes": 0,

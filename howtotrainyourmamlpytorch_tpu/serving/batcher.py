@@ -28,6 +28,8 @@ from typing import Any, Callable, Dict, Hashable, List, Tuple
 from ..observability.context import flow_step
 from ..observability.trace import NULL_TRACER
 
+from ..utils.locks import san_condition, san_lock
+
 
 class QueueFullError(RuntimeError):
     """submit() refused: the batcher already holds ``max_queue_depth``
@@ -71,8 +73,8 @@ class MicroBatcher:
         self.max_queue_depth = None if max_queue_depth is None else int(max_queue_depth)
         self.continuous = bool(continuous)
         self.name = name
-        self._lock = threading.Lock()
-        self._wake = threading.Condition(self._lock)
+        self._lock = san_lock("MicroBatcher._lock")
+        self._wake = san_condition("MicroBatcher._wake", self._lock)
         # bucket key -> list of (payload, future, enqueue_time, ctx);
         # insertion-ordered so the group with the oldest head is flushed
         # first on deadline. ctx (observability/context.py RequestContext,

@@ -18,6 +18,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from ..utils.locks import san_lock
+
 # (checkpoint fingerprint, adaptation strategy, support-set digest)
 CacheKey = Tuple[str, str, str]
 
@@ -150,7 +152,7 @@ class AdaptedWeightCache:
         self.max_bytes = int(max_bytes)
         self.ttl_s = float(ttl_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = san_lock("AdaptedWeightCache._lock")
         # key -> (tree, nbytes, inserted_at); OrderedDict order = LRU order
         self._entries: "OrderedDict[CacheKey, Tuple[Any, int, float]]" = OrderedDict()
         self._bytes = 0

@@ -33,6 +33,8 @@ from ..observability.context import flow_end
 from ..observability.trace import NULL_TRACER
 from ..resilience.faults import injector_from
 
+from ..utils.locks import san_lock
+
 
 def _bucket_for(size: int, buckets: Sequence[int]) -> int:
     """Smallest bucket >= size; an oversize request keeps its exact shape
@@ -159,7 +161,7 @@ class AdaptationEngine:
         self._adapt_jit: Dict[Tuple[str, int, int], Any] = {}
         self._predict_jit: Dict[Tuple[str, int, int], Any] = {}
         self._refine_jit: Dict[Tuple[str, int, int], Any] = {}
-        self._jit_lock = threading.Lock()
+        self._jit_lock = san_lock("AdaptationEngine._jit_lock")
         # compile ledger (observability/compile_ledger.py): when set (ctor
         # param, or attribute assignment before the first request — the
         # ServingFrontend attaches a collector-only ledger when telemetry

@@ -31,6 +31,8 @@ from .batcher import MicroBatcher, QueueFullError
 from .cache import AdaptedWeightCache
 from .errors import ServiceUnavailableError
 
+from ..utils.locks import note_blocking, san_lock
+
 
 def _key_strategy(key) -> "str | None":
     """Strategy component of a batcher group key: ``(strategy, bucket)``
@@ -140,7 +142,7 @@ class EngineReplica:
                 pass_contexts=True,
                 continuous=continuous,
             )
-        self._lock = threading.Lock()
+        self._lock = san_lock("EngineReplica._lock")
         self._alive = True
         self._death_reason: Optional[str] = None
         self._counts: Dict[str, int] = {}
@@ -211,6 +213,10 @@ class EngineReplica:
         # worker-progress mark, read BEFORE submit: any flush completing
         # while we wait counts as progress when attributing a timeout below
         progress_mark = batcher.flushes_completed()
+        # graftsan seam: a caller entering the (blocking) engine dispatch
+        # while holding any instrumented lock stalls every thread behind
+        # that lock for up to request_deadline_s — report it, armed
+        note_blocking("EngineReplica.dispatch")
         try:
             fut = batcher.submit(bucket, payload, ctx=ctx)
         except QueueFullError as exc:

@@ -40,6 +40,8 @@ import yaml
 from ..core import TrainState
 from ..experiment import checkpoint as ckpt
 
+from ..utils.locks import san_lock
+
 
 def _tree_shapes(tree: Any) -> List[Tuple[str, Tuple[int, ...]]]:
     """Sorted (path, shape) pairs — the structural identity two checkpoints
@@ -81,7 +83,7 @@ class TenantRegistry:
             }
         if not self._entries:
             raise ValueError("tenant registry names no tenants")
-        self._lock = threading.Lock()
+        self._lock = san_lock("TenantRegistry._lock")
         # tenant -> (host TrainState, fingerprint); populated lazily
         self._masters: Dict[str, Tuple[TrainState, str]] = {}
         self.template: Optional[Any] = None

@@ -36,6 +36,8 @@ from .errors import ServiceUnavailableError
 from .gateway import rendezvous_score  # noqa: F401 — re-exported
 from .pool import EngineReplica
 
+from ..utils.locks import san_lock
+
 
 class NoRoutableReplicaError(ServiceUnavailableError):
     """Every replica is dead or breaker-open — the whole-fleet outage
@@ -52,7 +54,7 @@ class Router:
         self.replicas = replicas
         self.max_queued_per_replica = int(max_queued_per_replica)
         self.shed_retry_after_s = float(shed_retry_after_s)
-        self._lock = threading.Lock()
+        self._lock = san_lock("Router._lock")
         self._routed = [0] * len(replicas)
         self._routed_around = 0
         self._router_shed = 0

@@ -65,6 +65,8 @@ from .pool import EnginePool
 from .router import Router, rendezvous_score
 from .tenancy import QuotaExceededError, validate_request_tenant
 
+from ..utils.locks import san_condition, san_lock
+
 
 class _LazyTenantFingerprints:
     """Mapping view the session rehydrator hands ``SessionStore.load_all``:
@@ -109,6 +111,27 @@ class ServingFrontend:
         # resilience knobs ride the run config like the serving knobs do;
         # clock is injectable so breaker tests walk cooldowns without waiting
         self.resilience = resilience_cfg or engine.cfg.resilience
+        # graftsan: arm the lock-discipline sanitizer BEFORE this frontend
+        # constructs its locks/pool/batchers, so they come out instrumented.
+        # (Locks built earlier — e.g. the engine's jit lock — stay plain
+        # unless HTYMP_GRAFTSAN=1 armed the whole process at import time.)
+        self._graftsan = None
+        if getattr(self.resilience, "sanitizer", False) or os.environ.get(
+            "HTYMP_GRAFTSAN"
+        ) == "1":
+            try:
+                from tools.graftsan import runtime as _graftsan_runtime
+
+                _graftsan_runtime.arm()
+                self._graftsan = _graftsan_runtime
+            except ImportError:  # packaged without tools/: sanitizer off
+                self._graftsan = None
+        # close-audit baseline: threads alive before this frontend spawned
+        # any — whatever non-daemon thread outlives close() beyond these is
+        # a leak this frontend owns
+        self._graftsan_thread_baseline = {
+            t.ident for t in threading.enumerate()
+        }
         # one TelemetryHub per frontend (no logs dir — a server owns no run
         # directory; tracer + registry only, snapshot on demand). The SAME
         # registry backs the LatencyStats/EventCounters adapters, so the
@@ -146,6 +169,10 @@ class ServingFrontend:
             from ..experiment.storage import EventLog
 
             self.events = EventLog(access_log_dir)
+        if self._graftsan is not None and self.events is not None:
+            # sanitizer findings land as structured graftsan_violation
+            # records next to the serving events they implicate
+            self._graftsan.add_sink(self.events.append)
         if self.hub.enabled:
             # trace the engine's device dispatches and both batchers' flushes
             # through the hub's tracer (engines built standalone keep their
@@ -234,8 +261,8 @@ class ServingFrontend:
         # one lock guards the draining flag and the in-flight request count;
         # the condition lets the drain thread sleep until the count reaches
         # zero instead of polling
-        self._drain_lock = threading.Lock()
-        self._drain_zero = threading.Condition(self._drain_lock)
+        self._drain_lock = san_lock("ServingFrontend._drain_lock")
+        self._drain_zero = san_condition("ServingFrontend._drain_zero", self._drain_lock)
         self._draining = False
         self._inflight = 0
         self._drain_info: Dict[str, Any] = {}
@@ -249,7 +276,7 @@ class ServingFrontend:
         # LRU: a lineage evicted here costs nothing but history — the next
         # refine re-seeds a baseline. Stays empty with refine_enabled=false,
         # so the refine-off request path never pays for it.
-        self._lineage_lock = threading.Lock()
+        self._lineage_lock = san_lock("ServingFrontend._lineage_lock")
         self._lineages: "OrderedDict[Tuple[str, str, str], Any]" = OrderedDict()
         self._max_lineages = 4096
         # --- session spill/rehydrate (serving/sessions.py) ----------------
@@ -272,7 +299,7 @@ class ServingFrontend:
         # 503 "warming" until the set is compiled — DISTINCT from the
         # breaker's "degraded" — so an orchestrator holds traffic off a
         # replica that would eat cold XLA compiles on its first requests.
-        self._prewarm_lock = threading.Lock()
+        self._prewarm_lock = san_lock("ServingFrontend._prewarm_lock")
         self._prewarm: Dict[str, Any] = {"status": "disabled"}
         self._prewarm_thread: Optional[threading.Thread] = None
         aot_cfg = getattr(engine.cfg, "aot", None)
@@ -1612,6 +1639,13 @@ class ServingFrontend:
         for wd in self._watchdogs:
             wd.stop()
         self.pool.close(join_timeout_s)
+        if self._graftsan is not None:
+            # thread-leak audit: workers/watchdogs this frontend spawned
+            # must all be joined by now (reported as thread_leak events)
+            self._graftsan.audit_thread_leaks(
+                "ServingFrontend.close",
+                baseline=self._graftsan_thread_baseline,
+            )
         if self.access_log is not None:
             self.access_log.close()
         if self.events is not None:

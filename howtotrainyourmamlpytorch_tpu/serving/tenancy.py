@@ -36,6 +36,8 @@ import jax
 from ..config import DEFAULT_TENANT
 from .cache import tree_bytes
 
+from ..utils.locks import san_lock
+
 
 def normalize_tenant(tenant: Optional[str]) -> Optional[str]:
     """Request tenant -> internal identity. Absent, empty, and the explicit
@@ -109,7 +111,7 @@ class WeightPager:
         self.min_headroom_frac = float(min_headroom_frac)
         self.watermarks = watermarks
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = san_lock("WeightPager._lock")
         # tenant -> (device state, nbytes); OrderedDict order = LRU order
         self._resident: "OrderedDict[str, Tuple[Any, int]]" = OrderedDict()
         self._bytes = 0
@@ -133,7 +135,17 @@ class WeightPager:
             if entry is not None:
                 self._resident.move_to_end(tenant)
                 return entry[0]
-            host_state, _ = self.registry.host_state(tenant)
+        # miss: load the host master OUTSIDE the pager lock — host_state
+        # takes the registry lock (an earlier tier in order.toml, so holding
+        # ours across it is a GL210 inversion) and may read a checkpoint
+        # from disk, which would park every concurrent page-in behind I/O
+        host_state, _ = self.registry.host_state(tenant)
+        with self._lock:
+            entry = self._resident.get(tenant)
+            if entry is not None:
+                # raced page-in while we fetched; keep theirs, drop ours
+                self._resident.move_to_end(tenant)
+                return entry[0]
             t0 = self._clock()
             state = (
                 jax.device_put(host_state, self.device)
@@ -268,7 +280,7 @@ class TenantQuotas:
         # well-behaved client at exactly rate_rps never sheds
         self.burst = max(1.0, self.rate_rps)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = san_lock("TenantQuotas._lock")
         self._inflight: Dict[str, int] = {}
         # tenant -> (tokens, last refill time)
         self._buckets: Dict[str, Tuple[float, float]] = {}

@@ -3,7 +3,7 @@
 
 Usage:
     python scripts/chaos_soak.py --episodes 17 --seed 0 [--work-dir DIR]
-        [--no-subprocess]
+        [--no-subprocess] [--sanitize]
 
 Samples fault injections across every registered seam (checkpoint
 read/write, loader episode assembly, runner step dispatch, serving dispatch,
@@ -72,6 +72,13 @@ def main(argv=None) -> int:
         help="skip fork-a-fresh-interpreter episodes (rc=76 wedge, "
         "device-shrink) — faster, less coverage",
     )
+    parser.add_argument(
+        "--sanitize",
+        action="store_true",
+        help="arm the graftsan lock-discipline sanitizer (tools/graftsan) "
+        "for every episode; lock-order cycles, blocking-under-lock, and "
+        "thread leaks become campaign violations",
+    )
     args = parser.parse_args(argv)
     work_dir = args.work_dir or tempfile.mkdtemp(prefix="chaos_soak_")
     # in-process episodes print training progress; the one-JSON-line stdout
@@ -82,6 +89,7 @@ def main(argv=None) -> int:
             episodes=args.episodes,
             seed=args.seed,
             include_subprocess=not args.no_subprocess,
+            sanitize=args.sanitize,
         )
     print(json.dumps(verdict), flush=True)
     return 0 if verdict["ok"] else 1

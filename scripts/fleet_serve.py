@@ -36,6 +36,7 @@ in turn loads only its stdlib siblings. Enforced by the same banned-import
 subprocess probe as the gateway. See docs/OPERATIONS.md "Autoscaling".
 """
 
+# graftlint: import-light — supervises backends from a host with no jax (GL213 gates the closure)
 import argparse
 import importlib.util
 import json

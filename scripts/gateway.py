@@ -16,6 +16,7 @@ down cleanly (poller stopped, access/events logs flushed), rc 0. See
 docs/OPERATIONS.md "Multi-host serving".
 """
 
+# graftlint: import-light — a gateway host runs with no accelerator stack (GL213 gates the closure)
 import argparse
 import importlib.util
 import os

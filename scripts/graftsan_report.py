@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""graftsan verdict CLI: lock-discipline violations in, ONE JSON line out.
+
+Joins the sanitizer's two output streams — ``graftsan_violation`` events in
+a run's ``logs/events.jsonl`` and the raw ``HTYMP_GRAFTSAN_LOG`` JSON-lines
+file subprocess chaos episodes append to — into the one-line verdict the
+campaign and CI consume::
+
+    python scripts/graftsan_report.py --run-dir exps/<run>
+    python scripts/graftsan_report.py --log /tmp/chaos/graftsan.jsonl
+    python scripts/graftsan_report.py --run-dir exps/<run> --human
+
+Verdict fields: ``ok`` (zero violations), ``violations``, ``by_kind``
+(cycle / inversion / held-across-blocking / thread-leak counts), ``worst``
+(the first few cycle reports with both stacks — what the deadlock-triage
+runbook in docs/OPERATIONS.md reads). ``--human`` adds a readable rendering
+to stderr; stdout stays the single JSON line.
+
+rc 0 = clean, 1 = violations found, 2 = usage (no readable input).
+Import-light: stdlib only — runs on a gateway-only host, a broken tree,
+or inside the sweep preflight without costing a jax import.
+"""
+
+# graftlint: import-light — stdlib-only verdict CLI (GL213 gates the closure)
+import argparse
+import json
+import os
+import sys
+
+_RC_OK, _RC_VIOLATIONS, _RC_USAGE = 0, 1, 2
+
+#: cycle reports carried whole into the verdict (each has both stacks; more
+#: than a handful means one systemic inversion, not many distinct ones)
+_WORST_K = 3
+
+
+def _read_jsonl(path):
+    """(records, torn_line_count) — hard-killed processes tear final lines;
+    the report must explain those runs, not die on them."""
+    records, torn = [], 0
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    torn += 1
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError:
+        return None, 0
+    return records, torn
+
+
+def collect_violations(run_dir=None, log_path=None):
+    """All graftsan_violation records from the given sources; None when no
+    source was readable (usage error, distinct from a clean empty run)."""
+    sources = []
+    if run_dir:
+        sources.append((os.path.join(run_dir, "logs", "events.jsonl"), True))
+    if log_path:
+        sources.append((log_path, False))
+    violations, torn_total, readable = [], 0, False
+    for path, filter_events in sources:
+        records, torn = _read_jsonl(path)
+        if records is None:
+            continue
+        readable = True
+        torn_total += torn
+        for rec in records:
+            if not filter_events or rec.get("event") == "graftsan_violation":
+                violations.append(rec)
+    if not readable:
+        return None, 0
+    return violations, torn_total
+
+
+def build_report(violations, torn_lines=0):
+    by_kind = {}
+    for v in violations:
+        kind = v.get("kind", "unknown")
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+    worst = [
+        v
+        for v in violations
+        if v.get("kind") in ("lock_order_cycle", "lock_order_inversion")
+    ][:_WORST_K]
+    if not worst:
+        worst = violations[:_WORST_K]
+    return {
+        "tool": "graftsan",
+        "ok": not violations,
+        "violations": len(violations),
+        "by_kind": by_kind,
+        "worst": worst,
+        "torn_lines": torn_lines,
+    }
+
+
+def _render_human(report, out=sys.stderr):
+    print(
+        f"graftsan: {report['violations']} violation(s) "
+        f"({json.dumps(report['by_kind'])})",
+        file=out,
+    )
+    for v in report["worst"]:
+        print(f"-- {v.get('kind')}: {v.get('detail', '')}", file=out)
+        if v.get("kind") in ("lock_order_cycle", "lock_order_inversion"):
+            print(
+                f"   {v.get('site_a')} held while acquiring {v.get('site_b')} "
+                f"on thread {v.get('thread')}",
+                file=out,
+            )
+        for frame in v.get("stack_b") or []:
+            print(f"     {frame}", file=out)
+        for rev in v.get("reverse_edges") or []:
+            print(f"   reverse edge {rev.get('edge')}:", file=out)
+            for frame in rev.get("stack") or []:
+                print(f"     {frame}", file=out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--run-dir", default="", help="run dir (reads logs/events.jsonl)")
+    parser.add_argument(
+        "--log", default="", help="raw HTYMP_GRAFTSAN_LOG jsonl file"
+    )
+    parser.add_argument(
+        "--human", action="store_true", help="readable rendering to stderr"
+    )
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as exc:
+        code = exc.code if isinstance(exc.code, int) else _RC_USAGE
+        return _RC_OK if code == 0 else _RC_USAGE
+    if not args.run_dir and not args.log:
+        print("graftsan_report: --run-dir or --log required", file=sys.stderr)
+        return _RC_USAGE
+    violations, torn = collect_violations(
+        run_dir=args.run_dir or None, log_path=args.log or None
+    )
+    if violations is None:
+        print(
+            "graftsan_report: no readable events.jsonl / log file at the "
+            "given paths",
+            file=sys.stderr,
+        )
+        return _RC_USAGE
+    report = build_report(violations, torn)
+    if args.human:
+        _render_human(report)
+    print(json.dumps(report), flush=True)
+    return _RC_OK if report["ok"] else _RC_VIOLATIONS
+
+
+if __name__ == "__main__":
+    sys.exit(main())

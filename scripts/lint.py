@@ -2,9 +2,11 @@
 """graftlint CLI — lint the repo's program families for JAX/TPU hazards.
 
 Usage:
-    python scripts/lint.py [--json] [--rule GLxxx ...] [--list-rules] PATH...
+    python scripts/lint.py [--json] [--rule GLxxx ...] [--list-rules]
+        [--changed] PATH...
 
     python scripts/lint.py howtotrainyourmamlpytorch_tpu scripts
+    python scripts/lint.py --changed            # pre-commit: git-diff scope
 
 Exit codes: 0 = clean, 1 = findings, 2 = usage error. ``--json`` emits the
 machine-readable payload (schema asserted by tests/test_graftlint.py);
@@ -14,6 +16,7 @@ TPU time is burned. Rule catalog: docs/STATIC_ANALYSIS.md.
 
 import argparse
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
@@ -25,6 +28,41 @@ from tools.graftlint import (  # noqa: E402
     run_lint,
 )
 from tools.graftlint.engine import _ensure_rules_loaded  # noqa: E402
+
+
+def _changed_files(scope_paths):
+    """Python files changed per git — worktree diff vs HEAD plus untracked —
+    optionally intersected with the given scope paths. Returns None on git
+    failure (not a checkout, no HEAD yet)."""
+    top = subprocess.run(
+        ["git", "rev-parse", "--show-toplevel"], capture_output=True, text=True
+    )
+    if top.returncode != 0:
+        return None
+    root = top.stdout.strip()
+    names = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(cmd, cwd=root, capture_output=True, text=True)
+        if proc.returncode != 0:
+            return None
+        names.update(n for n in proc.stdout.splitlines() if n.strip())
+    scopes = [os.path.abspath(p) for p in scope_paths]
+    out = []
+    for name in sorted(names):
+        path = os.path.join(root, name)
+        if not name.endswith(".py") or not os.path.exists(path):
+            continue  # deleted files and non-Python changes
+        if scopes and not any(
+            os.path.abspath(path) == s
+            or os.path.abspath(path).startswith(s + os.sep)
+            for s in scopes
+        ):
+            continue
+        out.append(os.path.relpath(path))
+    return out
 
 
 def main(argv=None) -> int:
@@ -41,6 +79,14 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog and exit"
     )
+    parser.add_argument(
+        "--changed",
+        action="store_true",
+        help="lint only files git reports changed (worktree diff vs HEAD + "
+        "untracked), intersected with PATH... when given — the fast "
+        "pre-commit scope; cross-module rules (GL210 facts, GL213 closure) "
+        "only see the changed set, so scripts/sweep.sh keeps the full run",
+    )
     try:
         args = parser.parse_args(argv)
     except SystemExit as exc:
@@ -52,7 +98,7 @@ def main(argv=None) -> int:
         for rule_id in sorted(RULES):
             print(f"{rule_id}  {RULES[rule_id].title}")
         return 0
-    if not args.paths:
+    if not args.paths and not args.changed:
         print("lint.py: at least one path is required", file=sys.stderr)
         return 2
     for path in args.paths:
@@ -66,7 +112,20 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
-    active, suppressed = run_lint(args.paths, args.rule or None)
+    paths = args.paths
+    if args.changed:
+        paths = _changed_files(args.paths)
+        if paths is None:
+            print("lint.py: --changed needs a git checkout with a HEAD",
+                  file=sys.stderr)
+            return 2
+        if not paths:
+            # nothing changed = nothing to lint; still honor the output mode
+            active, suppressed = [], []
+            print(report_json(active, suppressed) if args.json
+                  else report_human(active, suppressed))
+            return 0
+    active, suppressed = run_lint(paths, args.rule or None)
     if args.json:
         print(report_json(active, suppressed))
     else:

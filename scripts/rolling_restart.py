@@ -33,6 +33,7 @@ gateway-only host: the drain/spawn/liveness primitives live in
 See docs/OPERATIONS.md "Multi-host serving".
 """
 
+# graftlint: import-light — rolls a fleet from an ops host with no jax (GL213 gates the closure)
 import argparse
 import importlib.util
 import json

@@ -23,6 +23,9 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -37,3 +40,37 @@ def pytest_configure(config):
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)
+
+
+# Modules whose tests spin up the threaded serving stack (frontends, pools,
+# batchers, gateways, supervisors): every test must join what it starts. A
+# surviving non-daemon thread here is tomorrow's wedged CI run — the same
+# audit graftsan's ServingFrontend.close() runs, applied per-test.
+_THREAD_LEAK_GUARDED = (
+    "tests.test_serving",  # covers test_serving.py + test_serving_fleet.py
+    "tests.test_gateway_fleet",
+    "tests.test_autoscaler",
+)
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard(request):
+    mod = getattr(request.module, "__name__", "")
+    if not mod.startswith(_THREAD_LEAK_GUARDED):
+        yield
+        return
+    from tools.graftsan.runtime import audit_thread_leaks
+
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    # executors/sweepers signalled to stop may need a beat to unwind; only
+    # threads still alive after the grace window are leaks
+    deadline = time.monotonic() + 5.0
+    leaked = audit_thread_leaks(request.node.nodeid, baseline=before)
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.05)
+        leaked = audit_thread_leaks(request.node.nodeid, baseline=before)
+    assert not leaked, (
+        f"{request.node.nodeid} leaked non-daemon thread(s): {leaked} — "
+        "close()/shutdown() what the test started"
+    )

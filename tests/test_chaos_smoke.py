@@ -78,6 +78,10 @@ def test_chaos_smoke_campaign_all_invariants_green(toy_dataset, tmp_path):
             assert rc in DOCUMENTED_RCS
     line = json.dumps(verdict)
     assert "\n" not in line and json.loads(line)["ok"] is True
+    # sanitizer off (the default) = no verdict block and ZERO new files —
+    # the graftsan log only exists when --sanitize asked for it
+    assert verdict["sanitizer"] is None
+    assert not os.path.exists(os.path.join(str(tmp_path), "graftsan.jsonl"))
 
 
 @pytest.mark.slow
@@ -88,12 +92,14 @@ def test_full_chaos_soak_cli(tmp_path):
     the ISSUE 14 cross-process gateway drills, the ISSUE 17 refinement
     rollback / across-drain drills, and the ISSUE 18 fleet surge /
     crash-loop drills) reports every invariant green in ONE JSON line,
-    rc 0."""
+    rc 0 — with the ISSUE 19 graftsan lock-discipline sanitizer armed
+    across all of it (``--sanitize``), reporting zero violations."""
     proc = subprocess.run(
         [
             sys.executable, "scripts/chaos_soak.py",
             "--episodes", "21", "--seed", "0",
             "--work-dir", str(tmp_path),
+            "--sanitize",
         ],
         cwd=REPO,
         capture_output=True,
@@ -107,6 +113,8 @@ def test_full_chaos_soak_cli(tmp_path):
     assert verdict["ok"] is True
     assert verdict["episodes"] == 21
     assert verdict["violations"] == []
+    assert verdict["sanitizer"]["armed"] is True
+    assert verdict["sanitizer"]["violations"] == 0, verdict["sanitizer"]
     kinds = {r["kind"] for r in verdict["episode_results"]}
     assert {
         "device-grow-resume", "sigterm-during-async-save",

@@ -650,12 +650,13 @@ def test_cli_json_output(tmp_path):
 def test_self_gate_shipped_tree_has_zero_unsuppressed_findings():
     """The whole point of the PR: every hazard class graftlint can see is
     either fixed or carries an inline justification. A new finding in the
-    package or scripts/ fails tier-1, not review."""
+    package, scripts/, or tools/ (the analyzers must pass their own gate)
+    fails tier-1, not review."""
     cwd = os.getcwd()
     os.chdir(REPO_ROOT)
     try:
         active, suppressed = run_lint(
-            ["howtotrainyourmamlpytorch_tpu", "scripts"]
+            ["howtotrainyourmamlpytorch_tpu", "scripts", "tools"]
         )
     finally:
         os.chdir(cwd)
@@ -1001,6 +1002,30 @@ def test_self_gate_covers_autoscaler_paths_explicitly():
     )
 
 
+def test_self_gate_covers_graftsan_paths_explicitly():
+    """The lock-discipline sanitizer (ISSUE 19) sits inside the self-gate
+    on its own terms: the runtime's own meta-lock use must never trip the
+    rules it exists to enforce, the report CLI is an import-light exit-code
+    consumer (GL213/GL301 territory), and the lock-factory shim is imported
+    by every threaded serving module — zero unsuppressed findings even if
+    the top-level path list is ever restructured."""
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        active, _ = run_lint(
+            [
+                os.path.join("tools", "graftsan"),
+                os.path.join("scripts", "graftsan_report.py"),
+                os.path.join("howtotrainyourmamlpytorch_tpu", "utils", "locks.py"),
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert active == [], "unsuppressed findings in graftsan paths:\n" + "\n".join(
+        f.format() for f in active
+    )
+
+
 def test_self_gate_catches_an_introduced_true_positive(tmp_path):
     """End-to-end: drop one fixture true positive next to real package code
     and the CLI must exit 1 with a GL id on stdout."""
@@ -1014,3 +1039,536 @@ def test_self_gate_catches_an_introduced_true_positive(tmp_path):
     )
     assert proc.returncode == 1
     assert "GL301" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# GL210 — lock-order inversion (graftsan static half)
+# ---------------------------------------------------------------------------
+
+
+def test_gl210_order_toml_inversion_true_positive(tmp_path, monkeypatch):
+    monkeypatch.chdir(REPO_ROOT)  # tools/graftsan/order.toml ranks must load
+    active, _ = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class MicroBatcher:
+            def __init__(self, pager):
+                self._lock = threading.Lock()
+                self._pager = pager
+
+            def flush(self):
+                with self._lock:
+                    with self._pager._lock:  # pager under batcher: inverted
+                        pass
+        """,
+        rules=["GL210"],
+    )
+    assert _rules_of(active) == ["GL210"]
+    assert "inverts the canonical hierarchy" in active[0].message
+    assert "tier 'pager'" in active[0].message
+
+
+def test_gl210_canonical_direction_is_clean(tmp_path, monkeypatch):
+    monkeypatch.chdir(REPO_ROOT)
+    active, _ = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class TenantRegistry:
+            def __init__(self, pager):
+                self._lock = threading.Lock()
+                self._pager = pager
+
+            def rotate(self):
+                with self._lock:
+                    with self._pager._lock:  # registry -> pager: canonical
+                        pass
+        """,
+        rules=["GL210"],
+    )
+    assert active == []
+
+
+def test_gl210_interprocedural_self_call_inversion(tmp_path, monkeypatch):
+    monkeypatch.chdir(REPO_ROOT)
+    active, _ = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class WeightPager:
+            def __init__(self, cache):
+                self._lock = threading.Lock()
+                self._cache = cache
+
+            def evict(self):
+                with self._lock:  # pager tier, via the enclosing class
+                    pass
+
+            def compact(self):
+                with self._cache._lock:  # cache tier held...
+                    self.evict()         # ...pager acquired underneath
+        """,
+        rules=["GL210"],
+    )
+    assert _rules_of(active) == ["GL210"]
+    assert "via self.evict()" in active[0].message
+
+
+def test_gl210_module_fact_inversion_and_suppression(tmp_path):
+    source = """
+        import threading
+
+        # graftsan: order=alpha_lock<beta_lock
+
+        class Widget:
+            def __init__(self):
+                self._alpha_lock = threading.Lock()
+                self._beta_lock = threading.Lock()
+
+            def bad(self):
+                with self._beta_lock:
+                    with self._alpha_lock:
+                        pass
+
+            def good(self):
+                with self._alpha_lock:
+                    with self._beta_lock:
+                        pass
+        """
+    active, _ = _lint_snippet(tmp_path, source, rules=["GL210"])
+    assert _rules_of(active) == ["GL210"]
+    assert "order=alpha_lock<beta_lock" in active[0].message
+    suppressed_src = source.replace(
+        "                with self._beta_lock:\n"
+        "                    with self._alpha_lock:",
+        "                with self._beta_lock:\n"
+        "                    # ABBA drill fixture  # graftlint: disable=GL210\n"
+        "                    with self._alpha_lock:",
+        1,
+    )
+    assert suppressed_src != source
+    active, suppressed = _lint_snippet(
+        tmp_path, suppressed_src, name="suppressed.py", rules=["GL210"]
+    )
+    assert active == []
+    assert _rules_of(suppressed) == ["GL210"]
+
+
+# ---------------------------------------------------------------------------
+# GL211 — guarded field stored bare in a sibling method
+# ---------------------------------------------------------------------------
+
+
+def test_gl211_bare_sibling_write_true_positive(tmp_path):
+    active, _ = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._status = "idle"
+
+            def run(self):
+                with self._lock:
+                    self._status = "busy"
+
+            def close(self):
+                self._status = "closed"  # bare store of a guarded field
+        """,
+        rules=["GL211"],
+    )
+    assert _rules_of(active) == ["GL211"]
+    assert "_status" in active[0].message and "run" in active[0].message
+
+
+def test_gl211_clean_negatives(tmp_path):
+    # __init__-only writes are construction, not guard evidence; *_locked
+    # methods run under the caller's lock; all-guarded classes are clean
+    active, _ = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class InitOnly:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 1
+
+            def poke(self):
+                self._x = 2  # nothing ever guards _x: GL211 stays quiet
+
+        class Disciplined:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def set(self, v):
+                with self._lock:
+                    self._n = v
+
+            def _apply_locked(self, v):
+                self._n = v  # caller holds the lock by convention
+        """,
+        rules=["GL211"],
+    )
+    assert active == []
+
+
+def test_gl211_suppression_semantics(tmp_path):
+    active, suppressed = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Flag:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._done = False
+
+            def finish(self):
+                with self._lock:
+                    self._done = True
+
+            def reset(self):
+                # single-writer teardown, readers gone  # graftlint: disable=GL211
+                self._done = False
+        """,
+        rules=["GL211"],
+    )
+    assert active == []
+    assert _rules_of(suppressed) == ["GL211"]
+
+
+# ---------------------------------------------------------------------------
+# GL212 — blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+
+def test_gl212_blocking_under_lock_true_positives(tmp_path):
+    active, _ = _lint_snippet(
+        tmp_path,
+        """
+        import queue
+        import threading
+        import time
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def drain(self, fut):
+                with self._lock:
+                    fut.result(timeout=5)        # Future wait under lock
+                    item = self._q.get(timeout=1)  # queue wait under lock
+                    time.sleep(0.1)              # sleep under lock
+                    return item
+        """,
+        rules=["GL212"],
+    )
+    assert _rules_of(active) == ["GL212", "GL212", "GL212"]
+    joined = " ".join(f.message for f in active)
+    assert ".result()" in joined and "queue wait" in joined and "time.sleep" in joined
+
+
+def test_gl212_clean_negatives(tmp_path):
+    active, _ = _lint_snippet(
+        tmp_path,
+        """
+        import queue
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+                self._meta = {}
+
+            def take(self):
+                with self._lock:
+                    # dict .get is not a queue wait; closures run later
+                    probe = self._meta.get("k")
+                    def later(fut):
+                        return fut.result(timeout=1)
+                    self._cb = later
+                    return probe
+
+            def outside(self, fut):
+                batch = None
+                with self._lock:
+                    batch = list(self._meta)
+                return fut.result(timeout=1)  # blocking AFTER the lock: fine
+        """,
+        rules=["GL212"],
+    )
+    assert active == []
+
+
+def test_gl212_dispatch_under_lock_and_suppression(tmp_path):
+    source = """
+        import threading
+
+        class Frontend:
+            def __init__(self, engine):
+                self._lock = threading.Lock()
+                self._engine = engine
+
+            def infer(self, batch):
+                with self._lock:
+                    return self._engine.dispatch(batch)
+        """
+    active, _ = _lint_snippet(tmp_path, source, rules=["GL212"])
+    assert _rules_of(active) == ["GL212"]
+    assert "dispatch" in active[0].message
+    suppressed_src = source.replace(
+        "                with self._lock:\n"
+        "                    return self._engine.dispatch(batch)",
+        "                with self._lock:\n"
+        "                    # single-replica bring-up path  # graftlint: disable=GL212\n"
+        "                    return self._engine.dispatch(batch)",
+    )
+    assert suppressed_src != source
+    active, suppressed = _lint_snippet(
+        tmp_path, suppressed_src, name="suppressed.py", rules=["GL212"]
+    )
+    assert active == []
+    assert _rules_of(suppressed) == ["GL212"]
+
+
+# ---------------------------------------------------------------------------
+# GL213 — import-light transitive closure
+# ---------------------------------------------------------------------------
+
+
+def _lint_tree(tmp_path, monkeypatch, files, rules=None):
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    monkeypatch.chdir(tmp_path)
+    return run_lint(["."], rules)
+
+
+def test_gl213_direct_and_transitive_heavy_imports(tmp_path, monkeypatch):
+    active, _ = _lint_tree(
+        tmp_path,
+        monkeypatch,
+        {
+            "lightcli.py": """
+                # graftlint: import-light
+                import midmod
+            """,
+            "midmod.py": """
+                import jax
+            """,
+            "lightbad.py": """
+                # graftlint: import-light
+                import jax.numpy
+            """,
+        },
+        rules=["GL213"],
+    )
+    assert _rules_of(active) == ["GL213", "GL213"]
+    by_path = {f.path: f for f in active}
+    assert "jax.numpy" in by_path["lightbad.py"].message
+    assert "midmod -> jax" in by_path["lightcli.py"].message
+
+
+def test_gl213_guarded_lazy_and_unmarked_are_clean(tmp_path, monkeypatch):
+    active, _ = _lint_tree(
+        tmp_path,
+        monkeypatch,
+        {
+            "lightok.py": """
+                # graftlint: import-light
+                import json
+
+                try:
+                    import jax  # optional by contract: guarded fallback
+                except ImportError:
+                    jax = None
+
+                def lazy():
+                    import howtotrainyourmamlpytorch_tpu
+                    return howtotrainyourmamlpytorch_tpu
+            """,
+            "heavy_but_unmarked.py": """
+                import jax
+            """,
+        },
+        rules=["GL213"],
+    )
+    assert active == []
+
+
+def test_gl213_suppression_semantics(tmp_path, monkeypatch):
+    active, suppressed = _lint_tree(
+        tmp_path,
+        monkeypatch,
+        {
+            "lightexc.py": """
+                # graftlint: import-light
+                # bench-only entry point, jax host guaranteed  # graftlint: disable=GL213
+                import jax
+            """,
+        },
+        rules=["GL213"],
+    )
+    assert active == []
+    assert _rules_of(suppressed) == ["GL213"]
+
+
+def test_shipped_import_light_contract_is_marked_and_clean(monkeypatch):
+    """The old subprocess probes' single source of truth: the gateway-host
+    CLIs and the graftsan runtime carry the import-light marker, and GL213
+    holds their transitive closure at zero findings."""
+    monkeypatch.chdir(REPO_ROOT)
+    from tools.graftlint.engine import load_project
+    from tools.graftlint.rules_concurrency import _module_is_import_light
+
+    project = load_project(["scripts", "tools", "howtotrainyourmamlpytorch_tpu"])
+    marked = {m.rel for m in project.modules if _module_is_import_light(m)}
+    for rel in (
+        "scripts/gateway.py",
+        "scripts/rolling_restart.py",
+        "scripts/fleet_serve.py",
+        "scripts/graftsan_report.py",
+        "tools/graftsan/runtime.py",
+    ):
+        assert rel in marked, f"{rel} lost its import-light marker"
+    active, _ = run_lint(
+        ["scripts", "tools", "howtotrainyourmamlpytorch_tpu"], ["GL213"]
+    )
+    assert active == [], "\n".join(f.format() for f in active)
+
+
+# ---------------------------------------------------------------------------
+# per-rule wall time in the JSON payload
+# ---------------------------------------------------------------------------
+
+
+def test_json_payload_reports_per_rule_wall_time(tmp_path):
+    active, suppressed = _lint_snippet(tmp_path, "x = 1\n")
+    payload = json.loads(report_json(active, suppressed))
+    times = payload["rule_times_ms"]
+    assert set(times) == set(RULES)
+    assert all(isinstance(v, float) and v >= 0.0 for v in times.values())
+
+
+# ---------------------------------------------------------------------------
+# --changed: the fast pre-commit scope
+# ---------------------------------------------------------------------------
+
+_SLEEPY = textwrap.dedent(
+    """\
+    import threading
+    import time
+
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def poke(self):
+            with self._lock:
+                time.sleep(0.1)
+    """
+)
+
+
+def _git(repo, *args):
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=str(repo),
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+
+
+def test_lint_changed_scopes_to_the_git_diff(tmp_path):
+    """``--changed`` lints exactly the worktree diff + untracked files: a
+    committed (unchanged) violation stays invisible, a fresh one is caught,
+    and the full-path run still sees both (the sweep.sh preflight mode)."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    (repo / "old_bad.py").write_text(_SLEEPY)
+    (repo / "clean.py").write_text("x = 1\n")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    (repo / "new_bad.py").write_text(_SLEEPY.replace("class C", "class D"))
+    (repo / "clean.py").write_text("x = 2\n")  # changed but violation-free
+
+    lint = os.path.join(REPO_ROOT, "scripts", "lint.py")
+    changed = subprocess.run(
+        [sys.executable, lint, "--changed", "--json"],
+        cwd=str(repo),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert changed.returncode == 1, (changed.stdout, changed.stderr)
+    payload = json.loads(changed.stdout)
+    files = {f["path"] for f in payload["findings"]}
+    assert any(p.endswith("new_bad.py") for p in files), payload
+    assert not any(p.endswith("old_bad.py") for p in files), payload
+
+    full = subprocess.run(
+        [sys.executable, lint, "--json", "."],
+        cwd=str(repo),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert full.returncode == 1
+    files = {f["path"] for f in json.loads(full.stdout)["findings"]}
+    assert any(p.endswith("old_bad.py") for p in files)
+
+    # scope paths intersect the diff: naming only the clean file = clean
+    scoped = subprocess.run(
+        [sys.executable, lint, "--changed", "--json", "clean.py"],
+        cwd=str(repo),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert scoped.returncode == 0, (scoped.stdout, scoped.stderr)
+    assert json.loads(scoped.stdout)["counts"] == {}
+
+
+def test_lint_changed_clean_tree_and_no_git_are_honest(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    (repo / "clean.py").write_text("x = 1\n")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    lint = os.path.join(REPO_ROOT, "scripts", "lint.py")
+    proc = subprocess.run(
+        [sys.executable, lint, "--changed"],
+        cwd=str(repo),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+    bare = tmp_path / "nogit"
+    bare.mkdir()
+    proc = subprocess.run(
+        [sys.executable, lint, "--changed"],
+        cwd=str(bare),
+        env={**os.environ, "GIT_CEILING_DIRECTORIES": str(tmp_path)},
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "git" in proc.stderr

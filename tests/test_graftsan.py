@@ -1,0 +1,259 @@
+"""Tier-1 drills for the graftsan lock-discipline sanitizer (tools/graftsan).
+
+Three contracts pinned here:
+
+- **seeded fixtures are caught deterministically** — an ABBA acquisition
+  pattern trips ``lock_order_cycle`` the moment the second edge lands (no
+  contention, no timing), and a ``Future.result`` under a held lock trips
+  ``held_across_blocking`` through the patched stdlib seam;
+- **the shipped tree is clean** — a sanitizer-armed in-process campaign
+  slice reports zero violations, and the WeightPager page-in path (the one
+  true positive GL210 surfaced, fixed in ``serving/tenancy.py``) stays
+  inversion-free under a registry-locking fake;
+- **off means off** — with the sanitizer disarmed the factories hand back
+  plain stdlib primitives (bit-identical types, zero overhead) and the
+  campaign writes no graftsan artifacts (test_chaos_smoke pins that half).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.resilience.campaign import run_campaign
+from howtotrainyourmamlpytorch_tpu.serving.tenancy import WeightPager
+
+from tools.graftsan import runtime
+
+from tests.test_runner import toy_dataset  # noqa: F401
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def armed():
+    runtime.arm()
+    runtime.reset()
+    yield runtime
+    runtime.disarm()
+    runtime.reset()
+
+
+# -- seeded fixtures: caught, deterministically -----------------------------
+
+
+def test_abba_cycle_is_caught_without_contention(armed):
+    """A then B, later B then A — the classic ABBA. The cycle is flagged on
+    the second edge's insert, with both acquisition stacks, without ever
+    needing the two threads to actually contend."""
+    a = armed.san_lock("FixtureA._lock")
+    b = armed.san_lock("FixtureB._lock")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cycles = [v for v in armed.violations() if v["kind"] == "lock_order_cycle"]
+    assert len(cycles) == 1, armed.violations()
+    v = cycles[0]
+    assert {v["site_a"], v["site_b"]} == {"FixtureA._lock", "FixtureB._lock"}
+    assert v["stack_b"] and v["reverse_edges"][0]["stack"]  # both sides
+    assert v["event"] == "graftsan_violation"  # events.jsonl-ready as-is
+    # deterministic: the same pattern again reports nothing new (deduped)
+    with b:
+        with a:
+            pass
+    assert (
+        len([x for x in armed.violations() if x["kind"] == "lock_order_cycle"])
+        == 1
+    )
+
+
+def test_held_across_dispatch_is_caught_via_patched_seam(armed):
+    """``Future.result`` while holding a lock — the held-across-dispatch
+    wedge shape (EngineReplica.dispatch guards against it with
+    ``note_blocking``). The patched stdlib seam catches it even though the
+    future is already done, so the drill never risks an actual hang."""
+    lock = armed.san_lock("FixtureC._lock")
+    pool = ThreadPoolExecutor(max_workers=1)
+    try:
+        fut = pool.submit(lambda: 7)
+        assert fut.result(timeout=5) == 7  # no lock held: clean
+        with lock:
+            assert fut.result(timeout=5) == 7  # held: violation
+    finally:
+        pool.shutdown(wait=True)
+    held = [v for v in armed.violations() if v["kind"] == "held_across_blocking"]
+    assert len(held) == 1, armed.violations()
+    assert "FixtureC._lock" in held[0]["held"]
+    assert "Future.result" in held[0]["blocking"]
+
+
+def test_declared_order_inversion_is_caught(armed):
+    """order.toml ranks registry before pager; nesting them the wrong way
+    round is an inversion even with no reverse edge recorded yet."""
+    pager = armed.san_lock("WeightPager._lock")
+    registry = armed.san_lock("TenantRegistry._lock")
+    with pager:
+        with registry:
+            pass
+    kinds = {v["kind"] for v in armed.violations()}
+    assert "lock_order_inversion" in kinds, armed.violations()
+
+
+def test_thread_leak_audit_names_the_leak(armed):
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="leaky-fixture")
+    t.start()
+    try:
+        leaked = armed.audit_thread_leaks("drill", baseline=set())
+        assert "leaky-fixture" in leaked
+        leaks = [v for v in armed.violations() if v["kind"] == "thread_leak"]
+        assert leaks and "leaky-fixture" in leaks[0]["threads"]
+    finally:
+        stop.set()
+        t.join()
+    # joined threads are not leaks
+    baseline = {x.ident for x in threading.enumerate()}
+    assert armed.audit_thread_leaks("after-join", baseline=baseline) == []
+
+
+# -- the shipped tree: clean under the armed sanitizer ----------------------
+
+
+def test_weight_pager_page_in_holds_no_lock_across_registry(armed):
+    """Regression for the GL210 true positive: WeightPager.resident used to
+    hold the pager lock across ``registry.host_state`` (registry lock +
+    checkpoint disk read) — a declared-order inversion and an I/O convoy.
+    The fixed path fetches outside the lock; a registry-locking fake under
+    the armed sanitizer proves it, and the old shape still trips."""
+    class FakeRegistry:
+        def __init__(self):
+            self._lock = armed.san_lock("TenantRegistry._lock")
+
+        def host_state(self, tenant):
+            with self._lock:
+                return {"w": np.ones((2, 2), np.float32)}, {"tenant": tenant}
+
+    pager = WeightPager(FakeRegistry(), template=None)
+    state = pager.resident("acme")
+    assert state is not None and pager.page_ins == 1
+    assert pager.resident("acme") is state  # hit path, still clean
+    assert [
+        v
+        for v in armed.violations()
+        if v["kind"] in ("lock_order_cycle", "lock_order_inversion")
+    ] == [], armed.violations()
+    # the pre-fix shape (registry fetched under the pager lock) is exactly
+    # what the sanitizer exists to catch — prove this test has teeth
+    with pager._lock:
+        pager.registry.host_state("evil")
+    assert any(
+        v["kind"] == "lock_order_inversion" for v in armed.violations()
+    )
+
+
+def test_sanitized_mini_campaign_reports_zero_violations(toy_dataset, tmp_path):
+    """The tier-1 slice of the acceptance run: a seeded in-process campaign
+    with ``sanitize=True`` arms every lock built through the factories and
+    must come back with a zero-violation sanitizer verdict block."""
+    verdict = run_campaign(
+        str(tmp_path),
+        episodes=2,
+        seed=0,
+        data_root=toy_dataset,
+        include_subprocess=False,
+        sanitize=True,
+        log=lambda m: None,
+    )
+    assert verdict["ok"], verdict["violations"]
+    san = verdict["sanitizer"]
+    assert san["armed"] is True
+    assert san["violations"] == 0 and san["by_kind"] == {}, san
+    assert san["torn_lines"] == 0
+    # the campaign restores the caller's env and disarms on the way out
+    assert os.environ.get("HTYMP_GRAFTSAN") != "1"
+    assert "HTYMP_GRAFTSAN_LOG" not in os.environ
+    runtime.reset()
+
+
+# -- off means off ----------------------------------------------------------
+
+
+def test_sanitizer_off_hands_out_plain_stdlib_primitives(monkeypatch):
+    monkeypatch.delenv("HTYMP_GRAFTSAN", raising=False)
+    runtime.disarm()
+    assert not runtime.enabled()
+    assert type(runtime.san_lock("X._lock")) is type(threading.Lock())
+    assert type(runtime.san_rlock("X._rlock")) is type(threading.RLock())
+    assert type(runtime.san_condition("X._cond")) is threading.Condition
+    # the package shim agrees (this is what serving/+resilience/ import)
+    from howtotrainyourmamlpytorch_tpu.utils import locks
+
+    assert locks.GRAFTSAN_AVAILABLE
+    assert type(locks.san_lock("Y._lock")) is type(threading.Lock())
+    locks.note_blocking("Y.dispatch")  # no-op, records nothing
+    assert runtime.violations() == []
+
+
+# -- the verdict CLI --------------------------------------------------------
+
+
+def test_graftsan_report_cli_contract(tmp_path):
+    """``scripts/graftsan_report.py``: one JSON line, rc 1 on violations,
+    rc 0 clean, rc 2 usage."""
+    log = tmp_path / "graftsan.jsonl"
+    log.write_text(
+        json.dumps(
+            {
+                "event": "graftsan_violation",
+                "kind": "lock_order_cycle",
+                "site_a": "A._lock",
+                "site_b": "B._lock",
+            }
+        )
+        + "\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "scripts/graftsan_report.py", "--log", str(log)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 1, proc.stderr
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1
+    payload = json.loads(lines[0])
+    assert payload["ok"] is False
+    assert payload["by_kind"] == {"lock_order_cycle": 1}
+
+    run_dir = tmp_path / "run"
+    (run_dir / "logs").mkdir(parents=True)
+    (run_dir / "logs" / "events.jsonl").write_text(
+        json.dumps({"event": "epoch_end", "epoch": 0}) + "\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "scripts/graftsan_report.py", "--run-dir", str(run_dir)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert json.loads(proc.stdout.strip())["ok"] is True
+
+    proc = subprocess.run(
+        [sys.executable, "scripts/graftsan_report.py"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 2

@@ -23,13 +23,16 @@ the JSON payload), so "how much is being waved through" stays observable.
 
 import ast
 import dataclasses
+import io
 import json
 import os
 import re
+import time
+import tokenize
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9*,\s]+?)\s*(?:#|$)")
-MARKER_RE = re.compile(r"#\s*graftlint:\s*(hot-path|threaded|holds-lock)\b")
+MARKER_RE = re.compile(r"#\s*graftlint:\s*(hot-path|threaded|holds-lock|import-light)\b")
 
 
 @dataclasses.dataclass
@@ -68,7 +71,17 @@ class Module:
         self.suppressions: Dict[int, Set[str]] = {}
         # line -> set of markers ('hot-path' | 'threaded' | 'holds-lock')
         self.markers: Dict[int, Set[str]] = {}
-        for i, text in enumerate(self.lines, start=1):
+        # markers/suppressions live in COMMENT tokens only — a docstring
+        # *mentioning* the marker syntax must not mark the module
+        try:
+            comment_lines = [
+                (tok.start[0], tok.string)
+                for tok in tokenize.generate_tokens(io.StringIO(source).readline)
+                if tok.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError):
+            comment_lines = list(enumerate(self.lines, start=1))
+        for i, text in comment_lines:
             m = SUPPRESS_RE.search(text)
             if m:
                 ids = {
@@ -228,6 +241,13 @@ def load_project(paths: List[str]) -> Project:
     return Project([os.path.abspath(p) for p in paths], modules, errors)
 
 
+#: per-rule wall time of the most recent :func:`run_lint`, rule id -> ms;
+#: surfaced as ``rule_times_ms`` in the JSON payload so the sweep preflight
+#: can budget lint cost (a rule creeping past its peers shows up in CI, not
+#: as a mystery slowdown)
+LAST_RULE_TIMES_MS: Dict[str, float] = {}
+
+
 def run_lint(
     paths: List[str], rule_ids: Optional[List[str]] = None
 ) -> Tuple[List[Finding], List[Finding]]:
@@ -240,10 +260,15 @@ def run_lint(
         [RULES[r.upper()] for r in rule_ids] if rule_ids else list(RULES.values())
     )
     findings: List[Finding] = list(project.parse_errors)
+    LAST_RULE_TIMES_MS.clear()
     for rule in selected:
+        started = time.perf_counter()
         for mod in project.modules:
             findings.extend(rule.check_module(mod, project))
         findings.extend(rule.check_project(project))
+        LAST_RULE_TIMES_MS[rule.id] = round(
+            (time.perf_counter() - started) * 1000.0, 3
+        )
     if rule_ids:
         # a shared analysis may emit sibling-rule findings (GL101/GL102 run
         # one fixpoint); honor the selection at the output boundary too
@@ -273,6 +298,7 @@ def report_json(active: List[Finding], suppressed: List[Finding]) -> str:
             "findings": [f.to_dict() for f in active],
             "counts": counts,
             "suppressed": [f.to_dict() for f in suppressed],
+            "rule_times_ms": dict(LAST_RULE_TIMES_MS),
         },
         indent=2,
     )

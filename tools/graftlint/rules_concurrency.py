@@ -2,6 +2,31 @@
 
 GL201  read-modify-write of shared state outside a lock in a threaded class
 GL202  untimed blocking waits (``Future.result()`` / ``Queue.get()``)
+GL210  lock acquisition order inverts the declared hierarchy
+GL211  field written under a lock in one method, stored bare in another
+GL212  blocking call made while holding a lock
+GL213  import-light module transitively imports a heavy root
+
+GL210–GL213 are the static half of the graftsan lock-discipline sanitizer
+(``tools/graftsan``). GL210 reads the canonical acquisition hierarchy from
+``tools/graftsan/order.toml`` (registry → pager → cache → batcher →
+breaker) plus per-module ``# graftsan: order=a<b`` facts, and walks nested
+``with`` acquisitions across the intra-class call graph (``self.m()``
+transitively, plus one cross-class hop through tier-named attributes like
+``self._pager``): acquiring an *earlier* tier while holding a *later* one
+is the static shadow of the ABBA deadlock the armed runtime reports as a
+``lock_order_cycle``. GL211 generalizes GL201 past single-method scope —
+a field the class guards in one method but plainly rebinds in another is
+either a missing guard or a misleading one (GL211 takes the plain-``Assign``
+shapes; GL201 keeps the read-modify-writes). GL212 is the static twin of
+the runtime held-across-blocking check: ``.result()``, queue ``.get()``,
+``urlopen``, socket ops, ``time.sleep`` and engine ``dispatch`` inside a
+``with <lock>:`` region stall every other thread behind the lock. GL213
+replaces the three duplicated subprocess import-probe tests: modules carrying
+the ``import-light`` marker comment must not reach ``jax``/the package root
+through their *transitive* module-level import closure (imports inside
+``try/except ImportError`` are optional by contract; function-local imports
+are lazy and exempt).
 
 A class is "threaded" when the linter can see concurrency in it: it starts a
 ``threading.Thread``/``Timer``, owns a ``ThreadPoolExecutor``, owns a lock
@@ -24,9 +49,11 @@ justification naming its supervisor.
 """
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from .engine import Finding, Module, Project, Rule, call_name, register
+from .engine import Finding, Module, Project, Rule, call_name, dotted_name, register
 
 LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
 THREAD_CTORS = {"Thread", "Timer"}
@@ -162,30 +189,31 @@ class UnguardedSharedWrite(Rule):
         return out
 
 
+def _queue_names(module: Module) -> Set[str]:
+    """Names (locals and self attrs, flattened) visibly bound to Queue
+    constructors anywhere in the module (shared by GL202 / GL212)."""
+    names: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _ctor_last(node.value) in QUEUE_CTORS:
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        names.add(target.id)
+                    else:
+                        attr = _self_attr(target)
+                        if attr:
+                            names.add(attr)
+    return names
+
+
 @register
 class UntimedBlockingWait(Rule):
     id = "GL202"
     title = "untimed blocking wait"
 
-    def _queue_names(self, module: Module) -> Set[str]:
-        """Names (locals and self attrs, flattened) visibly bound to Queue
-        constructors anywhere in the module."""
-        names: Set[str] = set()
-        for node in ast.walk(module.tree):
-            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                if _ctor_last(node.value) in QUEUE_CTORS:
-                    for target in node.targets:
-                        if isinstance(target, ast.Name):
-                            names.add(target.id)
-                        else:
-                            attr = _self_attr(target)
-                            if attr:
-                                names.add(attr)
-        return names
-
     def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
         findings: List[Finding] = []
-        queue_names = self._queue_names(module)
+        queue_names = _queue_names(module)
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call) or not isinstance(
                 node.func, ast.Attribute
@@ -224,6 +252,671 @@ class UntimedBlockingWait(Rule):
                             f"`{recv_name}.get()` with no timeout blocks "
                             "forever if the producer died; pass timeout= "
                             "and handle Empty",
+                        )
+                    )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# GL210 — lock-order inversion (static half of graftsan)
+# ---------------------------------------------------------------------------
+
+#: per-module order facts: `# graftsan: order=a<b` (a acquired before b)
+GRAFTSAN_ORDER_RE = re.compile(
+    r"#\s*graftsan:\s*order=([A-Za-z_]\w*)\s*<\s*([A-Za-z_]\w*)"
+)
+
+
+def _locky(attr: str) -> bool:
+    return any(frag in attr.lower() for frag in LOCKY_FRAGMENTS)
+
+
+def _funcs(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    return {
+        n.name: n
+        for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+class _Hierarchy:
+    """Ranks from tools/graftsan/order.toml: outermost tier = rank 0."""
+
+    def __init__(self, data: Optional[dict]):
+        self.order: List[str] = []
+        self.class_rank: Dict[str, int] = {}
+        self.attr_rank: Dict[str, int] = {}
+        if not data:
+            return
+        self.order = list(data.get("order") or [])
+        tiers = data.get("tiers") or {}
+        for tier, spec in tiers.items():
+            if tier not in self.order:
+                continue
+            rank = self.order.index(tier)
+            for cls in spec.get("classes") or []:
+                self.class_rank[cls] = rank
+            for attr in spec.get("attrs") or []:
+                self.attr_rank[attr] = rank
+
+    def tier(self, rank: int) -> str:
+        return self.order[rank] if 0 <= rank < len(self.order) else "?"
+
+
+def _load_hierarchy(project: Project) -> _Hierarchy:
+    cached = getattr(project, "_graftsan_hierarchy", None)
+    if cached is not None:
+        return cached
+    data = None
+    try:
+        from ..graftsan.runtime import load_order
+
+        data = load_order(
+            os.path.join(project.repo_root, "tools", "graftsan", "order.toml")
+        )
+    except ImportError:  # graftsan not importable: rank checks degrade off
+        data = None
+    hier = _Hierarchy(data)
+    project._graftsan_hierarchy = hier
+    return hier
+
+
+def _tier_class_index(project: Project, hier: _Hierarchy) -> Dict[str, Set[str]]:
+    """Class name (tier classes only) -> method names that acquire a lock
+    via `with self.<locky>:` anywhere in their body. Powers the one-hop
+    cross-class check (`self._pager.evict()` while holding a later tier)."""
+    cached = getattr(project, "_graftsan_tier_classes", None)
+    if cached is not None:
+        return cached
+    index: Dict[str, Set[str]] = {}
+    for mod in project.modules:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef) or cls.name not in hier.class_rank:
+                continue
+            acquiring: Set[str] = set()
+            for name, fn in _funcs(cls).items():
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            attr = _self_attr(item.context_expr)
+                            if attr and _locky(attr):
+                                acquiring.add(name)
+            index[cls.name] = acquiring
+    project._graftsan_tier_classes = index
+    return index
+
+
+class _Acq:
+    """One lock acquisition, attributed to a hierarchy tier where possible."""
+
+    __slots__ = ("rank", "labels", "line", "col", "desc")
+
+    def __init__(self, rank, labels, line, col, desc):
+        self.rank = rank
+        self.labels = labels
+        self.line = line
+        self.col = col
+        self.desc = desc
+
+
+@register
+class LockOrderInversion(Rule):
+    id = "GL210"
+    title = "lock acquisition inverts the declared hierarchy"
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        hier = _load_hierarchy(project)
+        facts: List[Tuple[str, str]] = []
+        for text in module.lines:
+            m = GRAFTSAN_ORDER_RE.search(text)
+            if m:
+                facts.append((m.group(1), m.group(2)))
+        if not hier.order and not facts:
+            return ()
+        tier_classes = _tier_class_index(project, hier)
+        findings: List[Finding] = []
+        for cls in [n for n in ast.walk(module.tree) if isinstance(n, ast.ClassDef)]:
+            info = _ClassInfo(module, cls)
+            analyzer = _LockOrderWalker(
+                self, module, cls, info, hier, facts, tier_classes
+            )
+            findings.extend(analyzer.check())
+        return findings
+
+
+class _LockOrderWalker:
+    def __init__(self, rule, module, cls, info, hier, facts, tier_classes):
+        self.rule = rule
+        self.module = module
+        self.cls = cls
+        self.info = info
+        self.hier = hier
+        self.facts = facts
+        self.tier_classes = tier_classes
+        self.methods = _funcs(cls)
+        self._summaries: Dict[str, List[_Acq]] = {}
+        self.findings: List[Finding] = []
+
+    # -- attribution --------------------------------------------------------
+
+    def _attribute(self, expr: ast.AST) -> Optional[_Acq]:
+        """Map a with-item to an acquisition; None when it isn't lock-like."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            if not (attr in self.info.lock_attrs or _locky(attr)):
+                return None
+            labels = {attr.lstrip("_"), self.cls.name}
+            rank = self.hier.class_rank.get(self.cls.name)
+            if rank is not None:
+                labels.add(self.hier.tier(rank))
+            return _Acq(
+                rank, labels, expr.lineno, expr.col_offset, f"self.{attr}"
+            )
+        dotted = dotted_name(expr)
+        if dotted is None or not isinstance(expr, ast.Attribute):
+            return None
+        parts = [p for p in dotted.split(".") if p != "self"]
+        if len(parts) < 2 or not _locky(parts[-1]):
+            return None
+        labels = {p.lstrip("_") for p in parts}
+        rank = None
+        for owner in parts[:-1]:
+            owner_rank = self.hier.attr_rank.get(owner.lstrip("_"))
+            if owner_rank is not None:
+                rank = owner_rank
+                labels.add(self.hier.tier(owner_rank))
+                break
+        return _Acq(rank, labels, expr.lineno, expr.col_offset, dotted)
+
+    # -- inversion checks ---------------------------------------------------
+
+    def _check_pair(self, held: _Acq, new: _Acq, line: int, col: int, via: str):
+        if (
+            held.rank is not None
+            and new.rank is not None
+            and new.rank < held.rank
+        ):
+            self.findings.append(
+                Finding(
+                    self.rule.id,
+                    self.module.rel,
+                    line,
+                    col,
+                    f"acquires {new.desc} (tier '{self.hier.tier(new.rank)}')"
+                    f" while holding {held.desc} (tier "
+                    f"'{self.hier.tier(held.rank)}'){via} — inverts the "
+                    "canonical hierarchy in tools/graftsan/order.toml; the "
+                    "moment another thread runs the canonical direction this "
+                    "is an ABBA deadlock",
+                )
+            )
+            return
+        for a, b in self.facts:
+            if a in new.labels and b in held.labels:
+                self.findings.append(
+                    Finding(
+                        self.rule.id,
+                        self.module.rel,
+                        line,
+                        col,
+                        f"acquires {new.desc} while holding {held.desc}"
+                        f"{via} — inverts the declared module fact "
+                        f"`# graftsan: order={a}<{b}`",
+                    )
+                )
+                return
+
+    def _check_acq(self, held: List[_Acq], new: _Acq, line: int, col: int, via=""):
+        for h in held:
+            self._check_pair(h, new, line, col, via)
+
+    # -- cross-class one-hop ------------------------------------------------
+
+    def _cross_class(self, call: ast.Call) -> Optional[_Acq]:
+        """`self.<attr>.m()` where <attr> names a hierarchy tier and some
+        class of that tier visibly acquires its own lock inside `m`."""
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        owner = call.func.value
+        attr = _self_attr(owner)
+        if attr is None:
+            return None
+        rank = self.hier.attr_rank.get(attr.lstrip("_"))
+        if rank is None:
+            return None
+        method = call.func.attr
+        tier = self.hier.tier(rank)
+        for cls_name, acquiring in self.tier_classes.items():
+            if self.hier.class_rank.get(cls_name) == rank and method in acquiring:
+                return _Acq(
+                    rank,
+                    {attr.lstrip("_"), tier, cls_name},
+                    call.lineno,
+                    call.col_offset,
+                    f"self.{attr}.{method}() (acquires {cls_name}'s lock)",
+                )
+        return None
+
+    # -- interprocedural summary (self.m() transitively) --------------------
+
+    def _summary(self, name: str, stack: Set[str]) -> List[_Acq]:
+        if name in self._summaries:
+            return self._summaries[name]
+        if name in stack or name not in self.methods:
+            return []
+        stack = stack | {name}
+        acqs: List[_Acq] = []
+        for node in ast.walk(self.methods[name]):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    acq = self._attribute(item.context_expr)
+                    if acq is not None:
+                        acqs.append(acq)
+            elif isinstance(node, ast.Call):
+                callee = self._self_call(node)
+                if callee is not None:
+                    acqs.extend(self._summary(callee, stack))
+                else:
+                    hop = self._cross_class(node)
+                    if hop is not None:
+                        acqs.append(hop)
+        self._summaries[name] = acqs
+        return acqs
+
+    @staticmethod
+    def _self_call(call: ast.Call) -> Optional[str]:
+        if (
+            isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "self"
+        ):
+            return call.func.attr
+        return None
+
+    # -- the walk -----------------------------------------------------------
+
+    def check(self) -> List[Finding]:
+        for name, fn in self.methods.items():
+            self._walk(fn.body, [])
+        return self.findings
+
+    def _walk(self, stmts: List[ast.stmt], held: List[_Acq]):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs run later, under their own discipline
+            if isinstance(stmt, ast.With):
+                pushed = 0
+                for item in stmt.items:
+                    acq = self._attribute(item.context_expr)
+                    if acq is not None:
+                        self._check_acq(held, acq, acq.line, acq.col)
+                        held.append(acq)
+                        pushed += 1
+                self._walk(stmt.body, held)
+                del held[len(held) - pushed : len(held)]
+                continue
+            if held:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = self._self_call(node)
+                    if callee is not None:
+                        for acq in self._summary(callee, set()):
+                            self._check_acq(
+                                held,
+                                acq,
+                                node.lineno,
+                                node.col_offset,
+                                via=f" via self.{callee}()",
+                            )
+                    else:
+                        hop = self._cross_class(node)
+                        if hop is not None:
+                            self._check_acq(held, hop, node.lineno, node.col_offset)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    self._walk(sub, held)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._walk(handler.body, held)
+
+
+# ---------------------------------------------------------------------------
+# GL211 — guarded field stored bare in a sibling method
+# ---------------------------------------------------------------------------
+
+
+@register
+class GuardedFieldBareWrite(Rule):
+    id = "GL211"
+    title = "lock-guarded field stored bare in a sibling method"
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for cls in [n for n in ast.walk(module.tree) if isinstance(n, ast.ClassDef)]:
+            info = _ClassInfo(module, cls)
+            if not info.threaded:
+                continue
+            guarded_by: Dict[str, Set[str]] = {}  # field -> methods guarding it
+            bare: List[Tuple[str, str, ast.stmt]] = []  # (field, method, node)
+            for name, fn in _funcs(cls).items():
+                exempt = (
+                    name in ("__init__", "__new__", "__del__")
+                    or name.endswith("_locked")
+                    or module.has_marker("holds-lock", fn.lineno)
+                )
+                self._scan(module, info, fn.body, False, name, exempt, guarded_by, bare)
+            for field, method, node in bare:
+                others = guarded_by.get(field, set()) - {method}
+                if not others:
+                    continue
+                findings.append(
+                    Finding(
+                        self.id,
+                        module.rel,
+                        node.lineno,
+                        node.col_offset,
+                        f"`self.{field} = ...` in {cls.name}.{method} without "
+                        f"the lock, but {', '.join(sorted(others))} writes it "
+                        "under `with <lock>:` — either the guard is missing "
+                        "here or misleading there; take the lock (or mark the "
+                        "method `*_locked` if the caller holds it)",
+                    )
+                )
+        return findings
+
+    def _scan(self, module, info, stmts, guarded, method, exempt, guarded_by, bare):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.With):
+                now = guarded or any(
+                    info.is_lock_guard(item.context_expr) for item in stmt.items
+                )
+                self._scan(module, info, stmt.body, now, method, exempt, guarded_by, bare)
+                continue
+            if isinstance(stmt, ast.Assign):
+                for target in stmt.targets:
+                    attr = _self_attr(target)
+                    if attr is None or attr in info.lock_attrs:
+                        continue
+                    if guarded:
+                        guarded_by.setdefault(attr, set()).add(method)
+                    elif not exempt:
+                        # exempt methods (__init__, *_locked, holds-lock)
+                        # neither prove a guard nor violate one
+                        bare.append((attr, method, stmt))
+            elif isinstance(stmt, ast.AugAssign):
+                attr = _self_attr(stmt.target)
+                if attr is not None and guarded:
+                    # RMW under lock marks the field guarded; the bare-RMW
+                    # case is GL201's finding, not ours
+                    guarded_by.setdefault(attr, set()).add(method)
+            for attr_name in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr_name, None)
+                if sub and not isinstance(stmt, ast.With):
+                    self._scan(module, info, sub, guarded, method, exempt, guarded_by, bare)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._scan(module, info, handler.body, guarded, method, exempt, guarded_by, bare)
+
+
+# ---------------------------------------------------------------------------
+# GL212 — blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+#: socket-family method names that park the calling thread on the network
+SOCKET_BLOCKERS = {"connect", "accept", "recv", "recv_into", "sendall"}
+
+
+@register
+class LockHeldAcrossBlocking(Rule):
+    id = "GL212"
+    title = "blocking call while holding a lock"
+
+    def check_module(self, module: Module, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        queue_names = _queue_names(module)
+        for cls in [n for n in ast.walk(module.tree) if isinstance(n, ast.ClassDef)]:
+            info = _ClassInfo(module, cls)
+            for name, fn in _funcs(cls).items():
+                self._walk(module, cls.name, info, fn.body, False, queue_names, findings)
+        return findings
+
+    def _walk(self, module, cls_name, info, stmts, guarded, queue_names, findings):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # closures run later, usually outside the lock
+            if isinstance(stmt, ast.With):
+                now = guarded or any(
+                    info.is_lock_guard(item.context_expr) for item in stmt.items
+                )
+                self._walk(module, cls_name, info, stmt.body, now, queue_names, findings)
+                continue
+            if guarded:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call):
+                        why = self._blocking(module, node, queue_names)
+                        if why:
+                            findings.append(
+                                Finding(
+                                    self.id,
+                                    module.rel,
+                                    node.lineno,
+                                    node.col_offset,
+                                    f"{why} inside a `with <lock>:` block in "
+                                    f"{cls_name} — every thread needing the "
+                                    "lock stalls behind this call (the armed "
+                                    "graftsan runtime reports the same shape "
+                                    "as held_across_blocking); move the call "
+                                    "outside the guarded region",
+                                )
+                            )
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub and not isinstance(stmt, ast.With):
+                    self._walk(module, cls_name, info, sub, guarded, queue_names, findings)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._walk(module, cls_name, info, handler.body, guarded, queue_names, findings)
+
+    def _blocking(self, module: Module, call: ast.Call, queue_names) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            if attr == "result":
+                return "`.result()` (Future wait)"
+            if attr == "dispatch":
+                return "engine `.dispatch()`"
+            if attr in SOCKET_BLOCKERS:
+                return f"socket `.{attr}()`"
+            if attr == "get":
+                recv = func.value
+                recv_name = (
+                    recv.id if isinstance(recv, ast.Name) else _self_attr(recv) or ""
+                )
+                if recv_name in queue_names:
+                    return f"`{recv_name}.get()` (queue wait)"
+        dotted = dotted_name(func)
+        if dotted:
+            root = dotted.split(".")[0]
+            resolved = module.resolve_root(root)
+            full = resolved + dotted[len(root):] if resolved != root else dotted
+            if full == "time.sleep" or dotted == "time.sleep":
+                return "`time.sleep()`"
+            if full.endswith("urlopen") or dotted.endswith("urlopen"):
+                return "`urlopen()` (HTTP I/O)"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# GL213 — import-light transitive closure
+# ---------------------------------------------------------------------------
+
+#: roots an import-light module must never reach at module scope: importing
+#: jax (or the package root, whose __init__ pulls config -> jax) on a
+#: gateway-only host is exactly what the old subprocess probes banned
+HEAVY_ROOTS = ("jax", "jaxlib", "howtotrainyourmamlpytorch_tpu")
+
+
+def _module_is_import_light(module: Module) -> bool:
+    return any("import-light" in marks for marks in module.markers.values())
+
+
+def _required_imports(module: Module) -> List[Tuple[str, int, int]]:
+    """(dotted, line, col) imports that RUN at import time: module scope and
+    class bodies, descending into plain If/With/For/While blocks. Imports
+    inside a try whose handlers catch ImportError are optional by contract;
+    imports inside functions are lazy. Mirrors the runtime probe semantics
+    (a banned `__import__` only fired for module-level imports)."""
+    out: List[Tuple[str, int, int]] = []
+
+    def guards_import_error(handlers) -> bool:
+        for handler in handlers:
+            if handler.type is None:
+                return True
+            names = []
+            if isinstance(handler.type, ast.Tuple):
+                names = [dotted_name(e) or "" for e in handler.type.elts]
+            else:
+                names = [dotted_name(handler.type) or ""]
+            for name in names:
+                if name.split(".")[-1] in (
+                    "ImportError",
+                    "ModuleNotFoundError",
+                    "Exception",
+                    "BaseException",
+                ):
+                    return True
+        return False
+
+    def visit(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    out.append((alias.name, stmt.lineno, stmt.col_offset))
+            elif isinstance(stmt, ast.ImportFrom):
+                base = stmt.module or ""
+                if stmt.level:
+                    pkg = module.rel[: -len(".py")].replace("/", ".")
+                    if pkg.endswith(".__init__"):
+                        pkg = pkg[: -len(".__init__")]
+                    else:
+                        pkg = pkg.rsplit(".", 1)[0] if "." in pkg else ""
+                    for _ in range(stmt.level - 1):
+                        pkg = pkg.rsplit(".", 1)[0] if "." in pkg else ""
+                    base = f"{pkg}.{base}" if base else pkg
+                if base:
+                    for alias in stmt.names:
+                        out.append(
+                            (f"{base}.{alias.name}", stmt.lineno, stmt.col_offset)
+                        )
+            elif isinstance(stmt, ast.Try):
+                if not guards_import_error(stmt.handlers):
+                    visit(stmt.body)
+                visit(stmt.orelse)
+                visit(stmt.finalbody)
+                for handler in stmt.handlers:
+                    visit(handler.body)
+            elif isinstance(stmt, ast.If):
+                test = dotted_name(stmt.test) or ""
+                if "TYPE_CHECKING" not in test:
+                    visit(stmt.body)
+                visit(stmt.orelse)
+            else:
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        visit(sub)
+
+    visit(module.tree.body)
+    return out
+
+
+@register
+class ImportLightClosure(Rule):
+    id = "GL213"
+    title = "import-light module reaches a heavy root"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        by_rel = {m.rel: m for m in project.modules}
+
+        def resolve(dotted: str) -> Optional[Module]:
+            # `from a.b import name` may target module a.b.name or attr
+            # `name` of a/b.py — try the deeper path first
+            path = dotted.replace(".", "/")
+            for cand in (path + ".py", path + "/__init__.py"):
+                mod = by_rel.get(cand)
+                if mod is not None:
+                    return mod
+            if "." in dotted:
+                return resolve(dotted.rsplit(".", 1)[0])
+            return None
+
+        def heavy(dotted: str) -> bool:
+            root = dotted.split(".")[0]
+            return root in HEAVY_ROOTS
+
+        # closure cache: module rel -> offending chain (list of dotted) or None
+        chains: Dict[str, Optional[List[str]]] = {}
+
+        def chase(mod: Module, stack: Set[str]) -> Optional[List[str]]:
+            if mod.rel in chains:
+                return chains[mod.rel]
+            if mod.rel in stack:
+                return None
+            stack = stack | {mod.rel}
+            result: Optional[List[str]] = None
+            for dotted, _line, _col in _required_imports(mod):
+                if heavy(dotted):
+                    result = [dotted]
+                    break
+                target = resolve(dotted)
+                if target is not None and target.rel != mod.rel:
+                    sub = chase(target, stack)
+                    if sub is not None:
+                        result = [dotted] + sub
+                        break
+            chains[mod.rel] = result
+            return result
+
+        for mod in project.modules:
+            if not _module_is_import_light(mod):
+                continue
+            for dotted, line, col in _required_imports(mod):
+                if heavy(dotted):
+                    findings.append(
+                        Finding(
+                            self.id,
+                            mod.rel,
+                            line,
+                            col,
+                            f"import-light module imports `{dotted}` at module "
+                            "scope — it must load on a gateway-only host with "
+                            "no jax and without executing the package "
+                            "__init__; lazy-import it inside the function "
+                            "that needs it, or guard with try/except "
+                            "ImportError",
+                        )
+                    )
+                    continue
+                target = resolve(dotted)
+                if target is None or target.rel == mod.rel:
+                    continue
+                chain = chase(target, {mod.rel})
+                if chain is not None:
+                    findings.append(
+                        Finding(
+                            self.id,
+                            mod.rel,
+                            line,
+                            col,
+                            f"import-light module imports `{dotted}`, whose "
+                            "transitive module-scope closure reaches "
+                            f"`{chain[-1]}` (chain: {dotted} -> "
+                            f"{' -> '.join(chain)}) — the heavy root loads "
+                            "on every host that imports this module",
                         )
                     )
         return findings

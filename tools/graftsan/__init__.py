@@ -1,0 +1,32 @@
+"""graftsan — lock-discipline sanitizer (runtime half).
+
+``runtime.py`` ships the ``san_lock`` / ``san_rlock`` / ``san_condition``
+factories every threaded module in ``serving/`` + ``resilience/`` +
+``observability/`` constructs its primitives through. With the sanitizer off
+(the default) each factory returns the plain stdlib primitive — zero
+overhead, bit-identical behavior. Armed (``HTYMP_GRAFTSAN=1`` or
+``Config.resilience.sanitizer``), the factories return instrumented wrappers
+that maintain a global site-keyed acquisition-order graph, report
+lock-order cycles the moment the second edge lands (no actual deadlock
+needed), flag blocking calls made while a lock is held, and audit thread
+leaks at close seams.
+
+The static half lives in ``tools/graftlint`` (rules GL210–GL213), sharing
+the canonical hierarchy in ``order.toml`` via :func:`runtime.load_order`.
+"""
+
+from .runtime import (  # noqa: F401
+    add_sink,
+    arm,
+    audit_thread_leaks,
+    disarm,
+    enabled,
+    load_order,
+    note_blocking,
+    reset,
+    san_condition,
+    san_lock,
+    san_rlock,
+    snapshot,
+    violations,
+)

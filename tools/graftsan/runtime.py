@@ -1,0 +1,605 @@
+"""graftsan runtime — lockdep-style lock-order sanitizer, stdlib-only.
+
+Every threaded class in the serving / resilience / observability layers
+builds its primitives through the factories here::
+
+    self._lock = san_lock("MicroBatcher._lock")
+    self._wake = san_condition("MicroBatcher._wake", self._lock)
+
+Off (the default): the factories return plain ``threading.Lock`` /
+``RLock`` / ``Condition`` objects — no wrapper, no indirection, bit-identical
+to the hand-rolled constructions they replaced. Armed (``HTYMP_GRAFTSAN=1``
+in the environment, or :func:`arm` called from ``Config.resilience.sanitizer``
+wiring), the factories return ``SanLock`` / ``SanRLock`` wrappers that feed a
+single process-wide analysis:
+
+- **Acquisition-order graph.** Locks are keyed by *site* (owner class +
+  attribute name, e.g. ``"WeightPager._lock"``), not by instance: two
+  replicas' batcher locks are the same site. Acquiring B while holding A
+  lands the edge A→B (first-acquisition stack recorded). The moment an edge
+  closes a cycle — some path B→…→A already exists — a ``lock_order_cycle``
+  violation is reported with both stacks. No actual deadlock has to occur:
+  the two halves of an ABBA can run minutes apart, on threads that never
+  contend, and the cycle is still caught deterministically.
+
+- **Declared-hierarchy check.** ``order.toml`` ships the canonical
+  acquisition order (registry → pager → cache → batcher → breaker). An edge
+  that *inverts* the declared order is a ``lock_order_inversion`` violation
+  even before a full cycle exists — the dynamic twin of graftlint's GL210.
+
+- **Held-across-blocking.** While armed, ``concurrent.futures.Future.result``
+  and ``queue.Queue.get`` are wrapped to check the calling thread's held-lock
+  stack; serving seams call :func:`note_blocking` (engine dispatch, HTTP
+  I/O). A blocking wait with any SanLock held is a ``held_across_blocking``
+  violation — the shape that turns one hung device call into a
+  whole-process wedge (rc=76), because every other thread piles up behind
+  the held lock.
+
+- **Thread-leak audit.** :func:`audit_thread_leaks` (called from
+  ``ServingFrontend.close`` and the chaos campaign) reports non-daemon
+  threads alive beyond the arm-time baseline as ``thread_leak`` violations.
+
+Violations land in an in-process list (:func:`violations`), are pushed to
+registered sinks (the serving frontend and the runner forward them into
+``events.jsonl`` as ``graftsan_violation`` events), and — when
+``HTYMP_GRAFTSAN_LOG`` names a file — are appended there as JSON lines so
+subprocess chaos episodes report back to the campaign.
+``scripts/graftsan_report.py`` turns either stream into a one-JSON-line
+verdict.
+
+The sanitizer's own bookkeeping uses one plain ``threading.Lock`` held only
+for dict/list updates — never while acquiring a user lock or calling user
+code — so it cannot itself deadlock or invert an order.
+"""
+
+# graftlint: import-light — stdlib-only runtime (GL213 gates the closure)
+import json
+import os
+import threading
+import time
+import traceback
+
+__all__ = [
+    "SanLock",
+    "SanRLock",
+    "add_sink",
+    "arm",
+    "audit_thread_leaks",
+    "disarm",
+    "enabled",
+    "load_order",
+    "note_blocking",
+    "reset",
+    "san_condition",
+    "san_lock",
+    "san_rlock",
+    "snapshot",
+    "violations",
+]
+
+_ENV_FLAG = "HTYMP_GRAFTSAN"
+_ENV_LOG = "HTYMP_GRAFTSAN_LOG"
+#: stack frames kept per recorded acquisition (enough to name both sides of
+#: an inversion without dumping whole request stacks into events.jsonl)
+_STACK_DEPTH = 12
+
+
+class _State:
+    """All sanitizer state, guarded by one plain meta-lock (held only for
+    bookkeeping — never across user code, lock acquisition, or sinks)."""
+
+    def __init__(self):
+        self.meta = threading.Lock()
+        self.armed = False
+        # site -> {successor site -> edge record}
+        self.graph = {}
+        # (a, b) pairs already reported as cycles/inversions (dedup)
+        self.reported = set()
+        self.violations = []
+        self.sinks = []
+        self.baseline_threads = set()
+        self.order_rank = {}  # tier name -> rank
+        self.site_rank = {}  # memo: site -> rank or None
+        self.tier_classes = {}  # class-name fragment -> tier
+        self.blocking_patched = False
+        self.tls = threading.local()
+
+    def held(self):
+        stack = getattr(self.tls, "held", None)
+        if stack is None:
+            stack = self.tls.held = []
+        return stack
+
+
+_state = _State()
+
+
+def enabled() -> bool:
+    """True when the factories should hand out instrumented locks."""
+    return _state.armed or os.environ.get(_ENV_FLAG) == "1"
+
+
+def arm(order_path: str = None) -> None:
+    """Arm the sanitizer explicitly (the ``Config.resilience.sanitizer``
+    path; the env var arms implicitly). Loads the declared hierarchy,
+    snapshots the thread baseline for leak audits, and patches the stdlib
+    blocking seams. Idempotent."""
+    with _state.meta:
+        first = not _state.armed
+        _state.armed = True
+        if first:
+            _state.baseline_threads = {t.ident for t in threading.enumerate()}
+    if first:
+        _load_declared_order(order_path)
+        _patch_blocking_seams()
+
+
+def disarm() -> None:
+    with _state.meta:
+        _state.armed = False
+
+
+def reset() -> None:
+    """Clear the graph, violations, and baselines (tests; campaign start).
+    Keeps the armed flag and any registered sinks."""
+    with _state.meta:
+        _state.graph = {}
+        _state.reported = set()
+        _state.violations = []
+        _state.site_rank = {}
+        _state.baseline_threads = {t.ident for t in threading.enumerate()}
+
+
+def add_sink(fn) -> None:
+    """Register ``fn(violation_dict)``; buffered violations are replayed so
+    a sink attached after arming (the frontend's events.jsonl) misses
+    nothing."""
+    with _state.meta:
+        _state.sinks.append(fn)
+        backlog = list(_state.violations)
+    for record in backlog:
+        try:
+            fn(record)
+        except Exception:
+            pass
+
+
+def violations():
+    with _state.meta:
+        return list(_state.violations)
+
+
+def snapshot():
+    """Counts by kind + edge count — the /metrics-shaped summary."""
+    with _state.meta:
+        by_kind = {}
+        for v in _state.violations:
+            by_kind[v["kind"]] = by_kind.get(v["kind"], 0) + 1
+        edges = sum(len(s) for s in _state.graph.values())
+        return {
+            "armed": enabled(),
+            "violations": len(_state.violations),
+            "by_kind": by_kind,
+            "sites": len(_state.graph),
+            "edges": edges,
+        }
+
+
+# ---------------------------------------------------------------------------
+# order.toml — the canonical hierarchy (shared with graftlint GL210)
+# ---------------------------------------------------------------------------
+
+
+def load_order(path: str):
+    """Parse ``order.toml`` (this file predates a stdlib ``tomllib`` on the
+    shipped Python; the parser covers exactly the subset the file uses:
+    ``[section]`` headers, ``key = "str"`` and ``key = ["a", "b"]``).
+
+    Returns ``{"order": [tier, ...], "tiers": {tier: {"classes": [...],
+    "attrs": [...]}}}`` or None when the file is missing/unreadable."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return None
+    section = None
+    out = {"order": [], "tiers": {}}
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            section = line[1:-1].strip()
+            if section.startswith("tiers."):
+                out["tiers"].setdefault(section[6:], {})
+            continue
+        if "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        key, value = key.strip(), value.strip()
+        if value.startswith("["):
+            items = [
+                v.strip().strip("\"'")
+                for v in value.strip("[]").split(",")
+                if v.strip().strip("\"'")
+            ]
+        else:
+            items = value.strip("\"'")
+        if section == "hierarchy" and key == "order":
+            out["order"] = list(items)
+        elif section and section.startswith("tiers."):
+            out["tiers"][section[6:]][key] = items
+    if not out["order"]:
+        return None
+    return out
+
+
+def default_order_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "order.toml")
+
+
+def _load_declared_order(path: str = None) -> None:
+    spec = load_order(path or default_order_path())
+    if spec is None:
+        return
+    rank = {tier: i for i, tier in enumerate(spec["order"])}
+    classes = {}
+    for tier, info in spec["tiers"].items():
+        for cls in info.get("classes", []):
+            classes[cls] = tier
+    with _state.meta:
+        _state.order_rank = rank
+        _state.tier_classes = classes
+        _state.site_rank = {}
+
+
+def _rank_of_locked(site: str):
+    """Declared rank of a site ("Class.attr"), or None when its class is not
+    in any tier. Caller holds the meta lock."""
+    if site in _state.site_rank:
+        return _state.site_rank[site]
+    cls = site.split(".", 1)[0]
+    tier = _state.tier_classes.get(cls)
+    rank = _state.order_rank.get(tier) if tier else None
+    _state.site_rank[site] = rank
+    return rank
+
+
+# ---------------------------------------------------------------------------
+# violation recording
+# ---------------------------------------------------------------------------
+
+
+def _short_stack(skip: int = 2):
+    frames = traceback.extract_stack()[:-skip]
+    return [
+        f"{os.path.basename(f.filename)}:{f.lineno}:{f.name}"
+        for f in frames[-_STACK_DEPTH:]
+    ]
+
+
+def _record(kind: str, **fields) -> None:
+    record = {
+        "event": "graftsan_violation",
+        "kind": kind,
+        "thread": threading.current_thread().name,
+        "time": time.time(),
+    }
+    record.update(fields)
+    with _state.meta:
+        _state.violations.append(record)
+        sinks = list(_state.sinks)
+    log_path = os.environ.get(_ENV_LOG)
+    if log_path:
+        try:
+            with open(log_path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+                f.flush()
+        except OSError:
+            pass
+    for fn in sinks:
+        try:
+            fn(record)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# the acquisition-order analysis
+# ---------------------------------------------------------------------------
+
+
+def _path_between(graph, src, dst):
+    """Edge path src -> ... -> dst in the site graph, or None (iterative DFS
+    — the graph is tiny but recursion limits are not ours to burn)."""
+    stack = [(src, [])]
+    seen = set()
+    while stack:
+        node, path = stack.pop()
+        if node in seen:
+            continue
+        seen.add(node)
+        for succ in graph.get(node, {}):
+            edge_path = path + [(node, succ)]
+            if succ == dst:
+                return edge_path
+            if succ not in seen:
+                stack.append((succ, edge_path))
+    return None
+
+
+def _note_acquire(lock) -> None:
+    held = _state.held()
+    stack = None
+    new_edges = []
+    with _state.meta:
+        for held_lock in held:
+            a, b = held_lock.site, lock.site
+            if a == b:
+                # two instances of the same site nested in one thread: an
+                # ABBA with itself the instant another thread nests them the
+                # other way round — report unless explicitly address-ordered
+                if held_lock is not lock and (a, b) not in _state.reported:
+                    _state.reported.add((a, b))
+                    new_edges.append(("same_site", a, b, None))
+                continue
+            succ = _state.graph.setdefault(a, {})
+            if b not in succ:
+                if stack is None:
+                    stack = _short_stack(skip=4)
+                succ[b] = {"stack": stack, "thread": threading.current_thread().name}
+                new_edges.append(("edge", a, b, succ[b]))
+    held.append(lock)
+    if not new_edges:
+        return
+    # cycle / declared-order checks OUTSIDE the per-edge insert but re-taking
+    # the meta lock per query: the graph only grows, so a cycle present at
+    # insert time is still present here
+    for tag, a, b, edge in new_edges:
+        if tag == "same_site":
+            _record(
+                "lock_order_same_site",
+                site_a=a,
+                site_b=b,
+                detail="two instances of the same lock site nested in one "
+                "thread — order them by id() or restructure",
+                stack_b=_short_stack(skip=3),
+            )
+            continue
+        with _state.meta:
+            back_path = _path_between(_state.graph, b, a)
+            rank_a, rank_b = _rank_of_locked(a), _rank_of_locked(b)
+            back_stacks = None
+            if back_path:
+                back_stacks = [
+                    {
+                        "edge": f"{x}->{y}",
+                        "stack": _state.graph.get(x, {}).get(y, {}).get("stack"),
+                    }
+                    for x, y in back_path
+                ]
+                cycle_key = frozenset([(a, b)] + back_path)
+                if cycle_key in _state.reported:
+                    back_stacks = False  # already reported
+                else:
+                    _state.reported.add(cycle_key)
+            inversion = (
+                rank_a is not None
+                and rank_b is not None
+                and rank_b < rank_a
+                and (a, b, "inv") not in _state.reported
+            )
+            if inversion:
+                _state.reported.add((a, b, "inv"))
+        if back_stacks:
+            _record(
+                "lock_order_cycle",
+                site_a=a,
+                site_b=b,
+                detail=f"acquiring {b} while holding {a} closes a cycle: "
+                f"{' ; '.join(e['edge'] for e in back_stacks)} already "
+                "recorded — this is an ABBA deadlock waiting for contention",
+                stack_b=edge["stack"],
+                reverse_edges=back_stacks,
+            )
+        if inversion:
+            _record(
+                "lock_order_inversion",
+                site_a=a,
+                site_b=b,
+                detail=f"declared hierarchy orders {b} (rank {rank_b}) before "
+                f"{a} (rank {rank_a}) — acquiring it while holding {a} "
+                "inverts tools/graftsan/order.toml",
+                stack_b=edge["stack"],
+            )
+
+
+def _note_release(lock) -> None:
+    held = _state.held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is lock:
+            del held[i]
+            return
+
+
+def held_sites():
+    """Sites held by the calling thread (outermost first)."""
+    return [lk.site for lk in _state.held()]
+
+
+class SanLock:
+    """Drop-in ``threading.Lock`` recording site-keyed acquisition order."""
+
+    _recursive = False
+
+    def __init__(self, site: str):
+        self.site = site
+        self._inner = self._make_inner()
+        self._depth = 0  # recursion depth (SanRLock); guarded by ownership
+
+    def _make_inner(self):
+        return threading.Lock()
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if self._recursive and self._depth:
+                self._depth += 1
+            else:
+                self._depth = 1
+                _note_acquire(self)
+        return ok
+
+    def release(self):
+        # note BEFORE the actual release: until release returns, the lock is
+        # still ours, and noting first keeps the held stack consistent if
+        # release raises on an unheld lock
+        if self._depth == 1:
+            _note_release(self)
+        self._depth = max(0, self._depth - 1)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<{type(self).__name__} site={self.site!r}>"
+
+
+class SanRLock(SanLock):
+    """Drop-in ``threading.RLock``; exposes the ``_release_save`` protocol so
+    ``threading.Condition`` wait/notify keeps the held-stack accurate."""
+
+    _recursive = True
+
+    def _make_inner(self):
+        return threading.RLock()
+
+    # Condition-integration protocol: a wait() fully releases the lock
+    # (however deep the recursion) and re-acquires it on wake — the held
+    # stack must mirror that or every post-wait acquisition looks nested.
+    def _release_save(self):
+        _note_release(self)
+        depth, self._depth = self._depth, 0
+        state = self._inner._release_save()
+        return (state, depth)
+
+    def _acquire_restore(self, saved):
+        state, depth = saved
+        self._inner._acquire_restore(state)
+        self._depth = depth
+        _note_acquire(self)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+# ---------------------------------------------------------------------------
+# factories
+# ---------------------------------------------------------------------------
+
+
+def san_lock(site: str = None):
+    """``threading.Lock`` when off; ``SanLock(site)`` when armed."""
+    if not enabled():
+        return threading.Lock()
+    return SanLock(site or _caller_site())
+
+
+def san_rlock(site: str = None):
+    if not enabled():
+        return threading.RLock()
+    return SanRLock(site or _caller_site())
+
+
+def san_condition(site: str = None, lock=None):
+    """``threading.Condition``; when armed and no lock is shared, the
+    condition's internal lock is a ``SanRLock`` so waits/notifies feed the
+    same analysis. A shared lock (the batcher's ``Condition(self._lock)``
+    pattern) carries its own site — tracking rides the lock itself."""
+    if not enabled():
+        return threading.Condition(lock)
+    return threading.Condition(lock if lock is not None else SanRLock(site or _caller_site()))
+
+
+def _caller_site() -> str:
+    for frame in reversed(traceback.extract_stack()[:-2]):
+        return f"{os.path.basename(frame.filename)}:{frame.lineno}"
+    return "<unknown>"
+
+
+# ---------------------------------------------------------------------------
+# blocking-call + thread-leak audits
+# ---------------------------------------------------------------------------
+
+
+def note_blocking(what: str, timeout=None) -> None:
+    """Serving seams (engine dispatch, HTTP proxy I/O) call this before a
+    potentially-blocking operation; cheap no-op when the sanitizer is off or
+    no lock is held by this thread."""
+    if not enabled():
+        return
+    held = held_sites()
+    if held:
+        _record(
+            "held_across_blocking",
+            blocking=what,
+            held=held,
+            timeout=timeout,
+            stack_b=_short_stack(skip=3),
+        )
+
+
+def _patch_blocking_seams() -> None:
+    """Wrap ``Future.result`` and ``Queue.get`` so a wait entered with a
+    SanLock held is reported. Patched once, on first arm; the wrappers are
+    pure pass-throughs for threads holding nothing."""
+    with _state.meta:
+        if _state.blocking_patched:
+            return
+        _state.blocking_patched = True
+    import queue as _queue
+    from concurrent.futures import Future as _Future
+
+    orig_result = _Future.result
+
+    def result(self, timeout=None):
+        note_blocking("Future.result", timeout=timeout)
+        return orig_result(self, timeout)
+
+    _Future.result = result
+
+    orig_get = _queue.Queue.get
+
+    def get(self, block=True, timeout=None):
+        if block:
+            note_blocking("Queue.get", timeout=timeout)
+        return orig_get(self, block, timeout)
+
+    _queue.Queue.get = get
+
+
+def audit_thread_leaks(context: str, baseline=None) -> list:
+    """Non-daemon threads alive beyond the arm-time (or given) baseline —
+    the threads a close() was supposed to join. Returns the leaked names
+    (empty when clean) and records a ``thread_leak`` violation when armed."""
+    base = baseline if baseline is not None else _state.baseline_threads
+    leaked = [
+        t.name
+        for t in threading.enumerate()
+        if t.is_alive()
+        and not t.daemon
+        and t is not threading.main_thread()
+        and t.ident not in base
+    ]
+    if leaked and enabled():
+        _record("thread_leak", context=context, threads=sorted(leaked))
+    return sorted(leaked)
